@@ -1,0 +1,35 @@
+"""tendermint_tpu — a TPU-native BFT state-machine-replication framework.
+
+A ground-up re-design of the capabilities of Tendermint Core
+(reference: /root/reference, pure Go) for TPU hardware:
+
+- The signature-verification hot path (commit verification, vote ingest,
+  light-client header verification, blocksync catch-up) runs as batched,
+  vmapped Ed25519 verification on TPU via JAX/XLA (see
+  ``tendermint_tpu.ops``), sharded over device meshes with
+  ``jax.sharding`` for very large validator sets.
+- The control plane (consensus state machine, p2p, mempool, storage,
+  RPC) stays on host, mirroring the reference's layering
+  (SURVEY.md section 1) but built Python/C++-native rather than Go.
+
+Layer map (bottom-up), mirroring reference layers 0-15:
+  utils/      — service lifecycle, events, bitarray       (ref: libs/)
+  encoding/   — protobuf wire codec (canonical bytes)     (ref: proto/ generated)
+  crypto/     — keys, batch verifier dispatch, merkle     (ref: crypto/)
+  ops/        — JAX/TPU device kernels: GF(2^255-19),
+                Edwards curve, batched Ed25519 verify     (ref: curve25519-voi dep)
+  parallel/   — meshes, shard_map batch sharding          (ref: goroutine concurrency)
+  types/      — Block/Vote/Commit/ValidatorSet/params     (ref: types/)
+  abci/       — ABCI++ application boundary               (ref: abci/)
+  storage/    — KV abstraction + block/state stores       (ref: internal/store, tm-db)
+  state/      — BlockExecutor, State                      (ref: internal/state)
+  consensus/  — BFT state machine, WAL, timeouts          (ref: internal/consensus)
+  mempool/    — priority mempool                          (ref: internal/mempool)
+  p2p/        — router, peers, encrypted transport        (ref: internal/p2p)
+  light/      — light client verifier/bisection           (ref: light/)
+  privval/    — signers with double-sign protection       (ref: privval/)
+  rpc/        — JSON-RPC surface                          (ref: rpc/)
+  node/       — node assembly                             (ref: node/)
+"""
+
+__version__ = "0.1.0"

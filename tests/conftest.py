@@ -1,0 +1,21 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+The build machine exposes one real TPU chip through the experimental
+``axon`` platform; tests instead run on CPU with 8 virtual devices so
+multi-chip sharding paths (shard_map over a Mesh) are exercised without
+real hardware, per the reference test strategy of substituting in-memory
+fakes for the real transport (SURVEY.md section 4).
+
+This must run before anything imports jax and initializes a backend.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

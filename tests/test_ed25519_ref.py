@@ -1,0 +1,92 @@
+"""Oracle self-tests: pure-Python ZIP-215 ed25519 vs the cryptography lib."""
+
+import os
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+
+
+def test_sign_verify_roundtrip():
+    priv, pub = ref.keypair_from_seed(b"\x01" * 32)
+    msg = b"hello tendermint tpu"
+    sig = ref.sign(priv, msg)
+    assert ref.verify_zip215_slow(pub, msg, sig)
+    assert ref.verify_zip215(pub, msg, sig)
+
+
+def test_reject_bad_sig():
+    priv, pub = ref.keypair_from_seed(b"\x02" * 32)
+    sig = bytearray(ref.sign(priv, b"msg"))
+    sig[0] ^= 1
+    assert not ref.verify_zip215_slow(pub, b"msg", bytes(sig))
+    assert not ref.verify_zip215(pub, b"msg", bytes(sig))
+    good = ref.sign(priv, b"msg")
+    assert not ref.verify_zip215(pub, b"other msg", good)
+
+
+def test_matches_cryptography_lib_signing():
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+    from cryptography.hazmat.primitives import serialization
+
+    for i in range(8):
+        seed = os.urandom(32)
+        lib_priv = Ed25519PrivateKey.from_private_bytes(seed)
+        lib_pub = lib_priv.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        priv, pub = ref.keypair_from_seed(seed)
+        assert pub == lib_pub
+        msg = os.urandom(40)
+        assert ref.sign(priv, msg) == lib_priv.sign(msg)
+
+
+def test_reject_noncanonical_s():
+    priv, pub = ref.keypair_from_seed(b"\x03" * 32)
+    sig = ref.sign(priv, b"m")
+    s = int.from_bytes(sig[32:], "little")
+    bad = sig[:32] + int.to_bytes(s + ref.L, 32, "little")
+    assert not ref.verify_zip215_slow(pub, b"m", bad)
+
+
+def test_accepts_noncanonical_encodings():
+    # Non-canonical encodings (y >= p) only exist for y in [p, 2^255), i.e.
+    # points whose canonical y is 0..18. y=0 (x=sqrt(-1), small order) and
+    # y=1 (identity) are both on-curve; their y+p encodings must decompress
+    # liberally to the same point and be rejected canonically.
+    for y in (0, 1):
+        canon = int.to_bytes(y, 32, "little")
+        noncanon = int.to_bytes(y + ref.P, 32, "little")
+        pt_c = ref.pt_decompress_liberal(canon)
+        pt_nc = ref.pt_decompress_liberal(noncanon)
+        assert pt_c is not None and pt_nc is not None
+        assert ref.pt_equal(pt_c, pt_nc)
+        assert ref.pt_decompress_canonical(noncanon) is None
+        assert ref.pt_decompress_canonical(canon) is not None
+
+
+def test_small_order_points_accepted_zip215():
+    # The all-zero pubkey encodes the point (0, 0)? y=0: x^2 = (0-1)/(0+1) = -1,
+    # x = sqrt(-1) exists => on-curve small-order point. ZIP-215 accepts it as
+    # a key; signatures verify against the cofactored equation.
+    small = int.to_bytes(0, 32, "little")
+    assert ref.pt_decompress_liberal(small) is not None
+    # identity encoding y=1
+    ident = int.to_bytes(1, 32, "little")
+    pt = ref.pt_decompress_liberal(ident)
+    assert pt is not None and ref.pt_is_identity(pt)
+    # With A = identity, any s < L with R = [s]B and k arbitrary verifies:
+    s = 12345
+    r_bytes = ref.pt_compress(ref.pt_mul(s, ref.B_POINT))
+    sig = r_bytes + int.to_bytes(s, 32, "little")
+    assert ref.verify_zip215_slow(ident, b"anything", sig)
+
+
+def test_point_arith_consistency():
+    pt = ref.pt_mul(7, ref.B_POINT)
+    lhs = ref.pt_add(pt, pt)
+    rhs = ref.pt_double(pt)
+    assert ref.pt_equal(lhs, rhs)
+    assert ref.pt_equal(ref.pt_mul(8 + 5, ref.B_POINT),
+                        ref.pt_add(ref.pt_mul(8, ref.B_POINT), ref.pt_mul(5, ref.B_POINT)))
+    assert ref.pt_is_identity(ref.pt_mul(ref.L, ref.B_POINT))
