@@ -1,0 +1,30 @@
+"""Crypto layer: keys, batch dispatch, merkle trees, host ed25519 oracle.
+
+Reference layer: crypto/ (SURVEY.md §2.1). The TPU batch engine itself
+lives in :mod:`tendermint_tpu.ops`; this package holds the host-side
+interfaces and the pure-Python ZIP-215 oracle used for correctness
+testing and sub-threshold fallback.
+"""
+
+from tendermint_tpu.crypto.keys import (  # noqa: F401
+    ADDRESS_LEN,
+    ED25519_KEY_TYPE,
+    SECP256K1_KEY_TYPE,
+    SR25519_KEY_TYPE,
+    Ed25519PrivKey,
+    Ed25519PubKey,
+    PrivKey,
+    PubKey,
+    Secp256k1PrivKey,
+    Secp256k1PubKey,
+    address_hash,
+    pubkey_from_proto,
+    pubkey_from_type_and_bytes,
+    pubkey_to_proto,
+)
+from tendermint_tpu.crypto.batch import (  # noqa: F401
+    BatchVerifier,
+    Ed25519BatchVerifier,
+    create_batch_verifier,
+    supports_batch_verifier,
+)

@@ -1,0 +1,104 @@
+"""Batch-verifier dispatch.
+
+Mirrors crypto/batch/batch.go:11-33: only key types with batch support
+(ed25519, sr25519) get a batch verifier; callers fall back to
+one-at-a-time verification otherwise. The ed25519 batch verifier routes
+to the TPU engine (tendermint_tpu.ops) above a size threshold and to the
+host oracle below it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from tendermint_tpu.crypto.keys import (
+    ED25519_KEY_TYPE,
+    SR25519_KEY_TYPE,
+    PubKey,
+)
+
+
+class BatchVerifier:
+    """crypto.BatchVerifier contract (crypto/crypto.go:58-76): Add entries,
+    then Verify once; returns (all_valid, per-entry validity)."""
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        raise NotImplementedError
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class Ed25519BatchVerifier(BatchVerifier):
+    """Accumulate-then-flush ed25519 batch verification.
+
+    Above ``device_threshold`` entries the batch is verified on the
+    accelerator via :func:`tendermint_tpu.ops.verify_batch`; below it, each
+    signature is checked on host (device dispatch overhead dominates for
+    tiny batches — the analog of the reference's batchVerifyThreshold at
+    types/validation.go:12-16).
+    """
+
+    def __init__(self, device_threshold: int = 16, use_device: Optional[bool] = None):
+        self._pks: List[bytes] = []
+        self._msgs: List[bytes] = []
+        self._sigs: List[bytes] = []
+        self.device_threshold = device_threshold
+        self.use_device = use_device  # None = auto
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key.type != ED25519_KEY_TYPE:
+            raise ValueError(f"ed25519 batch got {pub_key.type} key")
+        pk = pub_key.bytes()
+        if len(pk) != 32 or len(sig) != 64:
+            raise ValueError("malformed ed25519 entry")
+        self._pks.append(pk)
+        self._msgs.append(msg)
+        self._sigs.append(sig)
+
+    def __len__(self) -> int:
+        return len(self._pks)
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        n = len(self._pks)
+        if n == 0:
+            return False, []
+        use_device = self.use_device
+        if use_device is None:
+            use_device = n >= self.device_threshold
+        if use_device:
+            try:
+                from tendermint_tpu.ops import verify_batch
+            except ImportError:  # device engine unavailable: fail safe to host
+                use_device = False
+            else:
+                oks = verify_batch(self._pks, self._msgs, self._sigs)
+        if not use_device:
+            from tendermint_tpu.crypto.ed25519_ref import verify_zip215
+
+            oks = [
+                verify_zip215(pk, m, s)
+                for pk, m, s in zip(self._pks, self._msgs, self._sigs)
+            ]
+        return all(oks), list(oks)
+
+
+def supports_batch_verifier(pub_key: Optional[PubKey]) -> bool:
+    """crypto/batch/batch.go:26-33. sr25519 will join once its verifier
+    lands — advertising it now would route callers into a fail-closed
+    all-False verdict instead of the single-verify fallback."""
+    return pub_key is not None and pub_key.type == ED25519_KEY_TYPE
+
+
+def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
+    """crypto/batch/batch.go:11-22: dispatch on key type."""
+    if pub_key.type == ED25519_KEY_TYPE:
+        return Ed25519BatchVerifier()
+    if pub_key.type == SR25519_KEY_TYPE:
+        from tendermint_tpu.crypto.sr25519 import Sr25519BatchVerifier
+
+        return Sr25519BatchVerifier()
+    raise ValueError(f"key type {pub_key.type} does not support batching")

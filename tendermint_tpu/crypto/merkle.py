@@ -1,0 +1,221 @@
+"""RFC-6962 Merkle trees and proofs.
+
+Mirrors the reference semantics (crypto/merkle/tree.go, hash.go,
+proof.go): SHA-256, leaf prefix 0x00, inner prefix 0x01, split point =
+largest power of two strictly less than n, empty tree = SHA256("").
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+LEAF_PREFIX = b"\x00"
+INNER_PREFIX = b"\x01"
+HASH_SIZE = 32
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def empty_hash() -> bytes:
+    return _sha256(b"")
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _sha256(LEAF_PREFIX + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha256(INNER_PREFIX + left + right)
+
+
+def get_split_point(n: int) -> int:
+    """Largest power of two strictly less than n (crypto/merkle/tree.go:94)."""
+    if n < 1:
+        raise ValueError("n must be >= 1")
+    k = 1 << (n.bit_length() - 1)
+    if k == n:
+        k >>= 1
+    return k
+
+
+def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
+    """crypto/merkle.HashFromByteSlices, iteratively."""
+    n = len(items)
+    if n == 0:
+        return empty_hash()
+    hashes = [leaf_hash(item) for item in items]
+    # Bottom-up combine respecting the RFC-6962 split structure: combining
+    # pairs left-to-right per level reproduces the recursive split because
+    # the split point is the largest power of two < n.
+    return _hash_level(hashes)
+
+
+def _hash_level(hashes: List[bytes]) -> bytes:
+    n = len(hashes)
+    if n == 1:
+        return hashes[0]
+    k = get_split_point(n)
+    return inner_hash(_hash_level(hashes[:k]), _hash_level(hashes[k:]))
+
+
+def hash_from_map(m: dict) -> bytes:
+    """Deterministic map hash: keys sorted, each leaf a length-delimited
+    (key, value) pair so distinct maps cannot collide. Keys must be str or
+    bytes; values bytes."""
+    from tendermint_tpu.encoding.proto import length_delimited
+
+    items = []
+    for key in sorted(m, key=lambda k: k.encode() if isinstance(k, str) else k):
+        if isinstance(key, str):
+            kb = key.encode()
+        elif isinstance(key, bytes):
+            kb = key
+        else:
+            raise TypeError(f"map key must be str or bytes, got {type(key)}")
+        items.append(length_delimited(kb) + length_delimited(m[key]))
+    return hash_from_byte_slices(items)
+
+
+@dataclass
+class Proof:
+    """Merkle inclusion proof (crypto/merkle/proof.go:22-103)."""
+
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: List[bytes] = field(default_factory=list)
+
+    MAX_AUNTS = 100
+
+    def verify(self, root_hash: bytes, leaf: bytes) -> bool:
+        if self.total < 0 or self.index < 0 or len(self.aunts) > self.MAX_AUNTS:
+            return False
+        if leaf_hash(leaf) != self.leaf_hash:
+            return False
+        computed = self.compute_root_hash()
+        return computed is not None and computed == root_hash
+
+    def compute_root_hash(self) -> Optional[bytes]:
+        return _compute_hash_from_aunts(
+            self.index, self.total, self.leaf_hash, self.aunts
+        )
+
+
+def _compute_hash_from_aunts(
+    index: int, total: int, leaf: bytes, aunts: List[bytes]
+) -> Optional[bytes]:
+    if index >= total or index < 0 or total <= 0:
+        return None
+    if total == 1:
+        if aunts:
+            return None
+        return leaf
+    if not aunts:
+        return None
+    k = get_split_point(total)
+    if index < k:
+        left = _compute_hash_from_aunts(index, k, leaf, aunts[:-1])
+        if left is None:
+            return None
+        return inner_hash(left, aunts[-1])
+    right = _compute_hash_from_aunts(index - k, total - k, leaf, aunts[:-1])
+    if right is None:
+        return None
+    return inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(
+    items: Sequence[bytes],
+) -> Tuple[bytes, List[Proof]]:
+    """Root hash + proof per item (crypto/merkle/proof.go ProofsFromByteSlices)."""
+    n = len(items)
+    leaf_hashes = [leaf_hash(item) for item in items]
+    if n == 0:
+        return empty_hash(), []
+    proofs = [Proof(total=n, index=i, leaf_hash=leaf_hashes[i]) for i in range(n)]
+
+    def build(lo: int, hi: int) -> bytes:
+        if hi - lo == 1:
+            return leaf_hashes[lo]
+        k = get_split_point(hi - lo)
+        left = build(lo, lo + k)
+        right = build(lo + k, hi)
+        for i in range(lo, lo + k):
+            proofs[i].aunts.append(right)
+        for i in range(lo + k, hi):
+            proofs[i].aunts.append(left)
+        return inner_hash(left, right)
+
+    root = build(0, n)
+    return root, proofs
+
+
+# --- proof operators (crypto/merkle/proof_op.go) ----------------------------
+
+
+class ProofOperator:
+    """One step in a chained proof: run(values) -> values for the next op."""
+
+    def run(self, values: List[bytes]) -> List[bytes]:
+        raise NotImplementedError
+
+    def get_key(self) -> bytes:
+        raise NotImplementedError
+
+
+class ValueOp(ProofOperator):
+    """Leaf value inclusion op (crypto/merkle/proof_value.go): proves
+    key=>value is in the tree with the given root."""
+
+    TYPE = "simple:v"
+
+    def __init__(self, key: bytes, proof: Proof):
+        self.key = key
+        self.proof = proof
+
+    def run(self, values: List[bytes]) -> List[bytes]:
+        if len(values) != 1:
+            raise ValueError("ValueOp expects one value")
+        vhash = _sha256(values[0])
+        # leaf is the kv pair encoding: len-prefixed key + len-prefixed vhash
+        from tendermint_tpu.encoding.proto import length_delimited
+
+        kv = length_delimited(self.key) + length_delimited(vhash)
+        if leaf_hash(kv) != self.proof.leaf_hash:
+            raise ValueError("leaf hash mismatch")
+        root = self.proof.compute_root_hash()
+        if root is None:
+            raise ValueError("bad proof")
+        return [root]
+
+    def get_key(self) -> bytes:
+        return self.key
+
+
+class ProofOperators:
+    """Chain of operators verified outer-to-inner
+    (crypto/merkle/proof_op.go:47-87)."""
+
+    def __init__(self, ops: List[ProofOperator]):
+        self.ops = ops
+
+    def verify_value(self, root: bytes, keypath: List[bytes], value: bytes) -> None:
+        self.verify(root, keypath, [value])
+
+    def verify(self, root: bytes, keypath: List[bytes], args: List[bytes]) -> None:
+        keys = list(keypath)
+        for op in self.ops:
+            key = op.get_key()
+            if key:
+                if not keys or keys[-1] != key:
+                    raise ValueError(f"key mismatch on {key!r}")
+                keys.pop()
+            args = op.run(args)
+        if args != [root]:
+            raise ValueError("computed root does not match")
+        if keys:
+            raise ValueError("keypath not fully consumed")

@@ -1,0 +1,56 @@
+"""sr25519 (Schnorrkel/ristretto255) — interface stubs.
+
+The reference supports sr25519 keys with batch verification
+(crypto/sr25519/, via curve25519-voi's schnorrkel). A full Schnorrkel
+implementation requires Merlin/STROBE transcripts (Keccak-f[1600]) plus
+ristretto255 group ops; the device-side double-scalar-mult shares the
+curve25519 field engine in tendermint_tpu.ops. Planned for a later
+milestone — these stubs pin the API surface so dispatch code
+(crypto/batch) and validator sets are already multi-key-type aware.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Tuple
+
+from tendermint_tpu.crypto.keys import ADDRESS_LEN, SR25519_KEY_TYPE, PubKey
+
+
+class Sr25519PubKey(PubKey):
+    __slots__ = ("_bytes",)
+
+    def __init__(self, data: bytes):
+        if len(data) != 32:
+            raise ValueError("sr25519 pubkey must be 32 bytes")
+        self._bytes = bytes(data)
+
+    def address(self) -> bytes:
+        return hashlib.sha256(self._bytes).digest()[:ADDRESS_LEN]
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        # Fail closed: this type is reachable from untrusted wire input via
+        # pubkey_from_proto, so it must return False, never raise.
+        return False
+
+    @property
+    def type(self) -> str:
+        return SR25519_KEY_TYPE
+
+
+class Sr25519BatchVerifier:
+    def __init__(self):
+        self._entries: List[Tuple[bytes, bytes, bytes]] = []
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        self._entries.append((pub_key.bytes(), msg, sig))
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        # Fail closed until schnorrkel verification lands.
+        return False, [False] * len(self._entries)

@@ -1,0 +1,22 @@
+"""Canonical wire encoding (protobuf wire format, hand-rolled).
+
+The reference framework signs and hashes protobuf-encoded canonical
+structures (reference: types/canonical.go, proto/tendermint/types/
+canonical.proto). Byte-exact encoding is consensus-critical: every
+sign-bytes and every hashed struct must serialize identically across
+implementations. This package provides a minimal, dependency-free
+protobuf wire codec plus the canonical message encoders.
+"""
+
+from tendermint_tpu.encoding.proto import (  # noqa: F401
+    Reader,
+    encode_bytes_field,
+    encode_fixed64_field,
+    encode_message_field,
+    encode_sfixed64_field,
+    encode_string_field,
+    encode_varint,
+    encode_varint_field,
+    length_delimited,
+    tag,
+)

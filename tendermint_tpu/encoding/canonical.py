@@ -1,0 +1,140 @@
+"""Canonical sign-bytes encoders.
+
+Mirrors the reference's canonicalization + delimited marshalling
+(types/canonical.go, types/vote.go:141-170, proto/tendermint/types/
+canonical.proto, internal/libs/protoio/writer.go:110): sign-bytes are the
+varint-length-prefixed protobuf encoding of the canonical struct.
+
+Field-presence rules were verified against the generated gogo marshaller
+(canonical.pb.go:590-640): proto3 zero values are omitted, EXCEPT the
+non-nullable Timestamp in CanonicalVote/CanonicalProposal, which is always
+serialized (possibly as an empty message), and the non-nullable
+PartSetHeader inside CanonicalBlockID.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+from tendermint_tpu.encoding.proto import (
+    encode_bytes_field,
+    encode_message_field,
+    encode_sfixed64_field,
+    encode_string_field,
+    encode_varint_field,
+    length_delimited,
+)
+
+# SignedMsgType values (proto/tendermint/types/types.proto)
+SIGNED_MSG_TYPE_UNKNOWN = 0
+SIGNED_MSG_TYPE_PREVOTE = 1
+SIGNED_MSG_TYPE_PRECOMMIT = 2
+SIGNED_MSG_TYPE_PROPOSAL = 32
+
+
+class Timestamp(NamedTuple):
+    """google.protobuf.Timestamp: seconds + nanos since the Unix epoch."""
+
+    seconds: int = 0
+    nanos: int = 0
+
+    def encode(self) -> bytes:
+        return encode_varint_field(1, self.seconds) + encode_varint_field(
+            2, self.nanos
+        )
+
+    @classmethod
+    def from_unix_ns(cls, ns: int) -> "Timestamp":
+        return cls(ns // 1_000_000_000, ns % 1_000_000_000)
+
+    def to_unix_ns(self) -> int:
+        return self.seconds * 1_000_000_000 + self.nanos
+
+
+ZERO_TIME = Timestamp(0, 0)
+
+
+def encode_canonical_part_set_header(total: int, hash_: bytes) -> bytes:
+    return encode_varint_field(1, total) + encode_bytes_field(2, hash_)
+
+
+def encode_canonical_block_id(
+    hash_: bytes, psh_total: int, psh_hash: bytes
+) -> Optional[bytes]:
+    """Returns None for a nil BlockID (omitted entirely from the canonical
+    vote; reference: types/canonical.go CanonicalizeBlockID)."""
+    if not hash_ and psh_total == 0 and not psh_hash:
+        return None
+    psh = encode_canonical_part_set_header(psh_total, psh_hash)
+    return encode_bytes_field(1, hash_) + encode_message_field(2, psh, always=True)
+
+
+def canonical_vote_bytes(
+    chain_id: str,
+    msg_type: int,
+    height: int,
+    round_: int,
+    block_id: Optional[bytes],
+    timestamp: Timestamp,
+) -> bytes:
+    """Encoded CanonicalVote (NOT length-prefixed); ``block_id`` is the
+    pre-encoded canonical block ID or None."""
+    out = encode_varint_field(1, msg_type)
+    out += encode_sfixed64_field(2, height)
+    out += encode_sfixed64_field(3, round_)
+    if block_id is not None:
+        out += encode_message_field(4, block_id, always=True)
+    out += encode_message_field(5, timestamp.encode(), always=True)
+    out += encode_string_field(6, chain_id)
+    return out
+
+
+def vote_sign_bytes(
+    chain_id: str,
+    msg_type: int,
+    height: int,
+    round_: int,
+    block_id_hash: bytes,
+    psh_total: int,
+    psh_hash: bytes,
+    timestamp: Timestamp,
+) -> bytes:
+    """types.VoteSignBytes equivalent: delimited canonical vote."""
+    bid = encode_canonical_block_id(block_id_hash, psh_total, psh_hash)
+    return length_delimited(
+        canonical_vote_bytes(chain_id, msg_type, height, round_, bid, timestamp)
+    )
+
+
+def proposal_sign_bytes(
+    chain_id: str,
+    height: int,
+    round_: int,
+    pol_round: int,
+    block_id_hash: bytes,
+    psh_total: int,
+    psh_hash: bytes,
+    timestamp: Timestamp,
+) -> bytes:
+    """types.ProposalSignBytes equivalent (canonical.proto CanonicalProposal)."""
+    bid = encode_canonical_block_id(block_id_hash, psh_total, psh_hash)
+    out = encode_varint_field(1, SIGNED_MSG_TYPE_PROPOSAL)
+    out += encode_sfixed64_field(2, height)
+    out += encode_sfixed64_field(3, round_)
+    out += encode_varint_field(4, pol_round)
+    if bid is not None:
+        out += encode_message_field(5, bid, always=True)
+    out += encode_message_field(6, timestamp.encode(), always=True)
+    out += encode_string_field(7, chain_id)
+    return length_delimited(out)
+
+
+def vote_extension_sign_bytes(
+    chain_id: str, extension: bytes, height: int, round_: int
+) -> bytes:
+    """types.VoteExtensionSignBytes equivalent (CanonicalVoteExtension)."""
+    out = encode_bytes_field(1, extension)
+    out += encode_sfixed64_field(2, height)
+    out += encode_sfixed64_field(3, round_)
+    out += encode_string_field(4, chain_id)
+    return length_delimited(out)

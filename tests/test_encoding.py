@@ -1,0 +1,98 @@
+"""Sign-bytes golden vectors from the reference (types/vote_test.go:81-150)
+plus protobuf wire codec round-trips."""
+
+from tendermint_tpu.encoding import canonical
+from tendermint_tpu.encoding.canonical import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+    Timestamp,
+)
+from tendermint_tpu.encoding.proto import Reader, encode_varint
+
+# Go's zero time.Time as a protobuf Timestamp.
+GO_ZERO_TIME = Timestamp(-62135596800, 0)
+
+
+def sign_bytes(chain_id, msg_type, height, round_):
+    return canonical.vote_sign_bytes(
+        chain_id, msg_type, height, round_, b"", 0, b"", GO_ZERO_TIME
+    )
+
+
+def test_vote_sign_bytes_golden_vectors():
+    # types/vote_test.go:88-150
+    assert sign_bytes("", 0, 0, 0) == bytes(
+        [0xD, 0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF, 0xFF, 0x1]
+    )
+    precommit = bytes(
+        [0x21, 0x8, 0x2, 0x11, 1, 0, 0, 0, 0, 0, 0, 0, 0x19, 1, 0, 0, 0, 0, 0, 0, 0,
+         0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF, 0xFF, 0x1]
+    )
+    assert sign_bytes("", SIGNED_MSG_TYPE_PRECOMMIT, 1, 1) == precommit
+    prevote = bytes(
+        [0x21, 0x8, 0x1, 0x11, 1, 0, 0, 0, 0, 0, 0, 0, 0x19, 1, 0, 0, 0, 0, 0, 0, 0,
+         0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF, 0xFF, 0x1]
+    )
+    assert sign_bytes("", SIGNED_MSG_TYPE_PREVOTE, 1, 1) == prevote
+    no_type = bytes(
+        [0x1F, 0x11, 1, 0, 0, 0, 0, 0, 0, 0, 0x19, 1, 0, 0, 0, 0, 0, 0, 0,
+         0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF, 0xFF, 0x1]
+    )
+    assert sign_bytes("", 0, 1, 1) == no_type
+    with_chain = bytes(
+        [0x2E, 0x11, 1, 0, 0, 0, 0, 0, 0, 0, 0x19, 1, 0, 0, 0, 0, 0, 0, 0,
+         0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF, 0xFF, 0x1,
+         0x32, 0xD] + list(b"test_chain_id")
+    )
+    assert sign_bytes("test_chain_id", 0, 1, 1) == with_chain
+
+
+def test_vote_extension_sign_bytes():
+    # extension field does not affect vote sign bytes; it has its own
+    # canonical struct (types/vote_test.go:152-170 case 5 matches case 4).
+    got = canonical.vote_extension_sign_bytes("test_chain_id", b"extension", 1, 1)
+    r = Reader(got)
+    total = r.read_varint()
+    assert total == len(got) - 1
+    fields = {}
+    for field, wire in r.fields():
+        if wire == 2:
+            fields[field] = r.read_bytes()
+        elif wire == 1:
+            fields[field] = r.read_sfixed64()
+        else:
+            r.skip(wire)
+    assert fields == {1: b"extension", 2: 1, 3: 1, 4: b"test_chain_id"}
+
+
+def test_varint_negative_is_ten_bytes():
+    assert len(encode_varint(-1)) == 10
+    r = Reader(encode_varint(-62135596800))
+    assert r.read_svarint() == -62135596800
+
+
+def test_timestamp_roundtrip():
+    ts = Timestamp.from_unix_ns(1700000000_000000123)
+    assert ts == Timestamp(1700000000, 123)
+    enc = ts.encode()
+    assert enc == bytes([0x08, 0x80, 0xE2, 0xCF, 0xAA, 0x06, 0x10, 0x7B])
+
+
+def test_proposal_sign_bytes_parses():
+    got = canonical.proposal_sign_bytes(
+        "chain", 5, 2, -1, b"\xaa" * 32, 3, b"\xbb" * 32, Timestamp(100, 5)
+    )
+    r = Reader(got)
+    r.read_varint()
+    fields = {}
+    for field, wire in r.fields():
+        if wire == 2:
+            fields[field] = r.read_bytes()
+        elif wire == 1:
+            fields[field] = r.read_sfixed64()
+        else:
+            fields[field] = r.read_svarint()
+    assert fields[1] == 32  # SIGNED_MSG_TYPE_PROPOSAL
+    assert fields[2] == 5 and fields[3] == 2
+    assert fields[4] == -1  # pol_round, varint-encoded
+    assert fields[7] == b"chain"

@@ -1,0 +1,87 @@
+"""Key interface tests: addresses, signing, verification, proto codec."""
+
+import hashlib
+
+from tendermint_tpu.crypto import (
+    Ed25519PrivKey,
+    Ed25519PubKey,
+    Secp256k1PrivKey,
+    create_batch_verifier,
+    pubkey_from_proto,
+    pubkey_to_proto,
+    supports_batch_verifier,
+)
+
+
+def test_ed25519_address_is_sha256_prefix():
+    priv = Ed25519PrivKey.from_seed(b"\x07" * 32)
+    pub = priv.pub_key()
+    assert pub.address() == hashlib.sha256(pub.bytes()).digest()[:20]
+    assert len(pub.address()) == 20
+
+
+def test_ed25519_sign_verify():
+    priv = Ed25519PrivKey.generate()
+    pub = priv.pub_key()
+    sig = priv.sign(b"payload")
+    assert pub.verify_signature(b"payload", sig)
+    assert not pub.verify_signature(b"other", sig)
+    assert not pub.verify_signature(b"payload", sig[:-1])
+
+
+def test_secp256k1_sign_verify_and_address():
+    priv = Secp256k1PrivKey.generate()
+    pub = priv.pub_key()
+    assert len(pub.bytes()) == 33
+    assert pub.address() == hashlib.new(
+        "ripemd160", hashlib.sha256(pub.bytes()).digest()
+    ).digest()
+    sig = priv.sign(b"tx bytes")
+    assert len(sig) == 64
+    assert pub.verify_signature(b"tx bytes", sig)
+    assert not pub.verify_signature(b"bad", sig)
+    # high-s malleated signature must be rejected (low-s rule)
+    from tendermint_tpu.crypto.keys import SECP256K1_N
+
+    r = sig[:32]
+    s = int.from_bytes(sig[32:], "big")
+    high = r + (SECP256K1_N - s).to_bytes(32, "big")
+    assert not pub.verify_signature(b"tx bytes", high)
+
+
+def test_pubkey_proto_roundtrip():
+    priv = Ed25519PrivKey.from_seed(b"\x01" * 32)
+    pub = priv.pub_key()
+    enc = pubkey_to_proto(pub)
+    assert enc[0] == 0x0A  # field 1, wire 2
+    back = pubkey_from_proto(enc)
+    assert back == pub and isinstance(back, Ed25519PubKey)
+
+    spriv = Secp256k1PrivKey.generate()
+    enc2 = pubkey_to_proto(spriv.pub_key())
+    assert enc2[0] == 0x12  # field 2, wire 2
+    assert pubkey_from_proto(enc2) == spriv.pub_key()
+
+
+def test_batch_dispatch():
+    ed = Ed25519PrivKey.generate()
+    assert supports_batch_verifier(ed.pub_key())
+    sec = Secp256k1PrivKey.generate()
+    assert not supports_batch_verifier(sec.pub_key())
+
+    bv = create_batch_verifier(ed.pub_key())
+    msgs = [b"msg%d" % i for i in range(5)]
+    for m in msgs:
+        bv.add(ed.pub_key(), m, ed.sign(m))
+    ok, oks = bv.verify()
+    assert ok and all(oks) and len(oks) == 5
+
+    bv2 = create_batch_verifier(ed.pub_key())
+    for i, m in enumerate(msgs):
+        sig = ed.sign(m)
+        if i == 2:
+            sig = sig[:32] + bytes(32)  # s = 0 is canonical but wrong
+        bv2.add(ed.pub_key(), m, sig)
+    ok, oks = bv2.verify()
+    assert not ok
+    assert oks == [True, True, False, True, True]

@@ -1,0 +1,70 @@
+"""RFC-6962 merkle tree tests against independent recursion + known answers."""
+
+import hashlib
+
+from tendermint_tpu.crypto import merkle
+
+
+def _ref_hash(items):
+    """Independent recursive RFC-6962 implementation."""
+    if len(items) == 0:
+        return hashlib.sha256(b"").digest()
+    if len(items) == 1:
+        return hashlib.sha256(b"\x00" + items[0]).digest()
+    k = merkle.get_split_point(len(items))
+    return hashlib.sha256(
+        b"\x01" + _ref_hash(items[:k]) + _ref_hash(items[k:])
+    ).digest()
+
+
+def test_empty_tree():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+    assert (
+        merkle.hash_from_byte_slices([]).hex()
+        == "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"
+    )
+
+
+def test_single_leaf():
+    # RFC 6962 §2.1: MTH({d0}) = SHA-256(0x00 || d0)
+    assert (
+        merkle.hash_from_byte_slices([b""]).hex()
+        == "6e340b9cffb37a989ca544e6bb780a2c78901d3fb33738768511a30617afa01d"
+    )
+
+
+def test_matches_reference_recursion():
+    for n in [1, 2, 3, 4, 5, 7, 8, 9, 15, 16, 17, 33, 100]:
+        items = [bytes([i]) * (i % 5) for i in range(n)]
+        assert merkle.hash_from_byte_slices(items) == _ref_hash(items)
+
+
+def test_split_point():
+    # crypto/merkle/tree.go getSplitPoint: largest power of two < n
+    assert merkle.get_split_point(2) == 1
+    assert merkle.get_split_point(3) == 2
+    assert merkle.get_split_point(4) == 2
+    assert merkle.get_split_point(5) == 4
+    assert merkle.get_split_point(8) == 4
+    assert merkle.get_split_point(9) == 8
+
+
+def test_proofs():
+    for n in [1, 2, 3, 5, 8, 13]:
+        items = [b"item%d" % i for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, proof in enumerate(proofs):
+            assert proof.verify(root, items[i])
+            assert not proof.verify(root, b"wrong")
+            if n > 1:
+                other = (i + 1) % n
+                assert not proof.verify(root, items[other])
+
+
+def test_proof_tamper_rejected():
+    items = [b"a", b"b", b"c", b"d"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    p = proofs[2]
+    p.aunts[0] = b"\x00" * 32
+    assert not p.verify(root, items[2])
