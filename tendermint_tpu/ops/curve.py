@@ -1,0 +1,121 @@
+"""Batched twisted-Edwards curve ops for ed25519 on TPU.
+
+Points are tuples ``(X, Y, Z, T)`` of field-element batches (extended
+coordinates, x = X/Z, y = Y/Z, T = XY/Z). The addition law used is the
+unified a=-1 formula, which is COMPLETE for every pair of curve points
+(a = -1 is a square mod p and d/a is a non-square), so small-order and
+mixed-order inputs — which ZIP-215 must accept (reference:
+crypto/ed25519/ed25519.go:24-31) — need no special-casing.
+
+Decompression implements the liberal ZIP-215 variant: the caller passes
+y already reduced mod p (encodings with y >= p are accepted), the
+x == 0 && sign == 1 rejection is kept (RFC 8032 5.1.3).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from tendermint_tpu.ops.field import (
+    D2_FE,
+    D_FE,
+    SQRT_M1_FE,
+    fe_add,
+    fe_eq,
+    fe_is_zero,
+    fe_mul,
+    fe_mul_const,
+    fe_neg,
+    fe_one,
+    fe_parity,
+    fe_pow22523,
+    fe_reduce_full,
+    fe_select,
+    fe_sq,
+    fe_sub,
+    fe_zero,
+)
+
+Point = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]
+
+
+def pt_identity(n: int) -> Point:
+    return (fe_zero(n), fe_one(n), fe_one(n), fe_zero(n))
+
+
+def pt_neg(p: Point) -> Point:
+    x, y, z, t = p
+    return (fe_neg(x), y, z, fe_neg(t))
+
+
+def pt_add(p: Point, q: Point) -> Point:
+    """Unified (complete) a=-1 addition, add-2008-hwcd-3."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = fe_mul(fe_sub(y1, x1), fe_sub(y2, x2))
+    b = fe_mul(fe_add(y1, x1), fe_add(y2, x2))
+    c = fe_mul(fe_mul(t1, t2), jnp.asarray(D2_FE))
+    d = fe_add(fe_mul(z1, z2), fe_mul(z1, z2))
+    e = fe_sub(b, a)
+    f = fe_sub(d, c)
+    g = fe_add(d, c)
+    h = fe_add(b, a)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def pt_double(p: Point) -> Point:
+    """dbl-2008-hwcd, valid for all inputs."""
+    x1, y1, z1, _ = p
+    a = fe_sq(x1)
+    b = fe_sq(y1)
+    c = fe_add(fe_sq(z1), fe_sq(z1))
+    h = fe_add(a, b)
+    e = fe_sub(h, fe_sq(fe_add(x1, y1)))
+    g = fe_sub(a, b)
+    f = fe_add(c, g)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def pt_select(cond: jnp.ndarray, p: Point, q: Point) -> Point:
+    """cond: (N,) bool — p where cond else q, coordinate-wise."""
+    return tuple(fe_select(cond, a, b) for a, b in zip(p, q))  # type: ignore
+
+
+def pt_is_identity(p: Point) -> jnp.ndarray:
+    """(N,) bool: X ≡ 0 and Y ≡ Z (projective identity test)."""
+    x, y, z, _ = p
+    return fe_is_zero(x) & fe_is_zero(fe_sub(y, z))
+
+
+def pt_decompress(y: jnp.ndarray, sign: jnp.ndarray) -> Tuple[Point, jnp.ndarray]:
+    """Liberal (ZIP-215) decompression of a batch.
+
+    y: (20, N) limbs of the 255-bit y-coordinate (any value < 2^255 —
+    non-canonical encodings are accepted and reduced implicitly);
+    sign: (N,) int32 in {0, 1}.
+    Returns (point, valid) — invalid lanes hold the identity so the
+    downstream arithmetic stays well-defined.
+    """
+    n = y.shape[1]
+    y2 = fe_sq(y)
+    one = fe_one(n)
+    u = fe_sub(y2, one)
+    v = fe_add(fe_mul_const(y2, D_FE), one)
+    v3 = fe_mul(fe_sq(v), v)
+    v7 = fe_mul(fe_sq(v3), v)
+    x = fe_mul(fe_mul(u, v3), fe_pow22523(fe_mul(u, v7)))
+    vx2 = fe_mul(v, fe_sq(x))
+    root1 = fe_eq(vx2, u)
+    root2 = fe_eq(vx2, fe_neg(u))
+    x = fe_select(root2, fe_mul_const(x, SQRT_M1_FE), x)
+    on_curve = root1 | root2
+    xr = fe_reduce_full(x)
+    x_is_zero = jnp.all(xr == 0, axis=0)
+    valid = on_curve & ~(x_is_zero & (sign == 1))
+    wrong_parity = (xr[0] & 1) != sign
+    x = fe_select(wrong_parity, fe_neg(x), x)
+    pt: Point = (x, y, one, fe_mul(x, y))
+    ident = pt_identity(n)
+    return pt_select(valid, pt, ident), valid

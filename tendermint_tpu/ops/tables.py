@@ -1,0 +1,45 @@
+"""Host-side precomputed tables for the device verifier.
+
+The fixed-base table [0..15]B (extended coordinates, Z=1) is computed
+once at import with the pure-Python oracle and shipped to the device as
+a constant — the analog of curve25519-voi's precomputed basepoint tables
+(reference dependency of crypto/ed25519).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.ops.field import NLIMBS, P, int_to_limbs
+
+
+def _affine_extended(pt) -> tuple:
+    """Oracle extended point -> affine extended (x, y, 1, x*y) ints."""
+    x_, y_, z_, _ = pt
+    zinv = pow(z_, P - 2, P)
+    x = x_ * zinv % P
+    y = y_ * zinv % P
+    return (x, y, 1, x * y % P)
+
+
+def _point_limbs(pt) -> np.ndarray:
+    """(4, 20) int32 limbs for one affine-extended point."""
+    return np.array([int_to_limbs(c) for c in _affine_extended(pt)], dtype=np.int32)
+
+
+def _build_base_table(width: int = 16) -> np.ndarray:
+    """(width, 4, 20, 1) multiples [0..width-1]B; index 0 = identity."""
+    out = np.zeros((width, 4, NLIMBS), dtype=np.int32)
+    out[0] = np.array(
+        [int_to_limbs(0), int_to_limbs(1), int_to_limbs(1), int_to_limbs(0)],
+        dtype=np.int32,
+    )
+    acc = ref.B_POINT
+    for i in range(1, width):
+        out[i] = _point_limbs(acc)
+        acc = ref.pt_add(acc, ref.B_POINT)
+    return out[:, :, :, None]  # broadcastable over batch
+
+
+B_TABLE = _build_base_table()

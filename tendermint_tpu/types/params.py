@@ -1,0 +1,195 @@
+"""Consensus parameters.
+
+Mirrors types/params.go: Block/Evidence/Validator/Version/Synchrony/
+Timeout/ABCI parameter groups, defaults, validation, update-from-ABCI,
+and the hash (SHA-256 of the HashedParams proto — params.go:385-399).
+Durations are float seconds host-side (the reference uses ns).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field, replace
+from typing import List, Optional
+
+from tendermint_tpu.crypto.keys import (
+    ED25519_KEY_TYPE,
+    SECP256K1_KEY_TYPE,
+    SR25519_KEY_TYPE,
+)
+from tendermint_tpu.encoding.proto import encode_varint_field
+
+MAX_BLOCK_SIZE_BYTES = 104857600  # 100MB, types/params.go:24
+BLOCK_PART_SIZE_BYTES = 65536  # types/params.go:21
+MAX_BLOCK_PARTS_COUNT = (MAX_BLOCK_SIZE_BYTES // BLOCK_PART_SIZE_BYTES) + 1
+
+ABCI_PUBKEY_TYPE_ED25519 = ED25519_KEY_TYPE
+ABCI_PUBKEY_TYPE_SECP256K1 = SECP256K1_KEY_TYPE
+ABCI_PUBKEY_TYPE_SR25519 = SR25519_KEY_TYPE
+
+
+@dataclass
+class BlockParams:
+    max_bytes: int = 22020096  # 21MB
+    max_gas: int = -1
+
+
+@dataclass
+class EvidenceParams:
+    max_age_num_blocks: int = 100000
+    max_age_duration: float = 48 * 3600.0  # seconds
+    max_bytes: int = 1048576
+
+
+@dataclass
+class ValidatorParams:
+    pub_key_types: List[str] = field(
+        default_factory=lambda: [ABCI_PUBKEY_TYPE_ED25519]
+    )
+
+
+@dataclass
+class VersionParams:
+    app_version: int = 0
+
+
+@dataclass
+class SynchronyParams:
+    """Proposer-based timestamps bounds (types/params.go:81-89)."""
+
+    precision: float = 0.505  # seconds
+    message_delay: float = 12.0
+
+    def in_round(self, round_: int) -> "SynchronyParams":
+        """Per-round relaxation: message delay grows 10% per round so PBTS
+        eventually accepts any proposer timestamp (params.go SynchronyParams)."""
+        delay = self.message_delay
+        for _ in range(round_):
+            delay = delay * 1.1
+        return SynchronyParams(self.precision, delay)
+
+
+@dataclass
+class TimeoutParams:
+    """On-chain consensus timeouts (types/params.go:91-99)."""
+
+    propose: float = 3.0
+    propose_delta: float = 0.5
+    vote: float = 1.0
+    vote_delta: float = 0.5
+    commit: float = 1.0
+    bypass_commit_timeout: bool = False
+
+    def propose_timeout(self, round_: int) -> float:
+        return self.propose + self.propose_delta * round_
+
+    def vote_timeout(self, round_: int) -> float:
+        return self.vote + self.vote_delta * round_
+
+
+@dataclass
+class ABCIParams:
+    vote_extensions_enable_height: int = 0
+
+    def vote_extensions_enabled(self, height: int) -> bool:
+        if self.vote_extensions_enable_height == 0:
+            return False
+        return height >= self.vote_extensions_enable_height
+
+
+@dataclass
+class ConsensusParams:
+    block: BlockParams = field(default_factory=BlockParams)
+    evidence: EvidenceParams = field(default_factory=EvidenceParams)
+    validator: ValidatorParams = field(default_factory=ValidatorParams)
+    version: VersionParams = field(default_factory=VersionParams)
+    synchrony: SynchronyParams = field(default_factory=SynchronyParams)
+    timeout: TimeoutParams = field(default_factory=TimeoutParams)
+    abci: ABCIParams = field(default_factory=ABCIParams)
+
+    def hash(self) -> bytes:
+        """SHA-256 of HashedParams{block_max_bytes=1, block_max_gas=2}
+        (types/params.go:385-399)."""
+        payload = encode_varint_field(1, self.block.max_bytes) + encode_varint_field(
+            2, self.block.max_gas
+        )
+        return hashlib.sha256(payload).digest()
+
+    def validate(self) -> None:
+        """types/params.go ValidateConsensusParams."""
+        if self.block.max_bytes <= 0:
+            raise ValueError(f"block.max_bytes must be > 0, got {self.block.max_bytes}")
+        if self.block.max_bytes > MAX_BLOCK_SIZE_BYTES:
+            raise ValueError(
+                f"block.max_bytes exceeds {MAX_BLOCK_SIZE_BYTES}"
+            )
+        if self.block.max_gas < -1:
+            raise ValueError(f"block.max_gas must be >= -1, got {self.block.max_gas}")
+        if self.evidence.max_age_num_blocks <= 0:
+            raise ValueError("evidence.max_age_num_blocks must be > 0")
+        if self.evidence.max_age_duration <= 0:
+            raise ValueError("evidence.max_age_duration must be > 0")
+        if (
+            self.evidence.max_bytes > self.block.max_bytes
+            or self.evidence.max_bytes < 0
+        ):
+            raise ValueError("evidence.max_bytes invalid")
+        if self.synchrony.precision <= 0 or self.synchrony.message_delay <= 0:
+            raise ValueError("synchrony params must be positive")
+        for t in (
+            self.timeout.propose,
+            self.timeout.vote,
+            self.timeout.commit,
+        ):
+            if t <= 0:
+                raise ValueError("timeouts must be positive")
+        if self.timeout.propose_delta < 0 or self.timeout.vote_delta < 0:
+            raise ValueError("timeout deltas must be non-negative")
+        if not self.validator.pub_key_types:
+            raise ValueError("validator.pub_key_types must not be empty")
+        for kt in self.validator.pub_key_types:
+            if kt not in (
+                ABCI_PUBKEY_TYPE_ED25519,
+                ABCI_PUBKEY_TYPE_SECP256K1,
+                ABCI_PUBKEY_TYPE_SR25519,
+            ):
+                raise ValueError(f"unknown pubkey type {kt}")
+        if self.abci.vote_extensions_enable_height < 0:
+            raise ValueError("abci.vote_extensions_enable_height must be >= 0")
+
+    def update_from(self, updates: Optional["ConsensusParamsUpdate"]) -> "ConsensusParams":
+        """Apply a partial ABCI update (params.go UpdateConsensusParams)."""
+        if updates is None:
+            return self
+        out = replace(self)
+        if updates.block is not None:
+            out.block = updates.block
+        if updates.evidence is not None:
+            out.evidence = updates.evidence
+        if updates.validator is not None:
+            out.validator = updates.validator
+        if updates.version is not None:
+            out.version = updates.version
+        if updates.synchrony is not None:
+            out.synchrony = updates.synchrony
+        if updates.timeout is not None:
+            out.timeout = updates.timeout
+        if updates.abci is not None:
+            out.abci = updates.abci
+        return out
+
+
+@dataclass
+class ConsensusParamsUpdate:
+    """Partial update as delivered by the ABCI app (all groups optional)."""
+
+    block: Optional[BlockParams] = None
+    evidence: Optional[EvidenceParams] = None
+    validator: Optional[ValidatorParams] = None
+    version: Optional[VersionParams] = None
+    synchrony: Optional[SynchronyParams] = None
+    timeout: Optional[TimeoutParams] = None
+    abci: Optional[ABCIParams] = None
+
+
+DEFAULT_CONSENSUS_PARAMS = ConsensusParams

@@ -1,0 +1,85 @@
+"""Validator and sort orders (types/validator.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tendermint_tpu.crypto import PubKey, pubkey_to_proto
+from tendermint_tpu.encoding.proto import encode_message_field, encode_varint_field
+
+INT64_MAX = 2**63 - 1
+INT64_MIN = -(2**63)
+
+
+def safe_add_clip(a: int, b: int) -> int:
+    """int64 addition clipped at the bounds (libs math safe ops)."""
+    return max(INT64_MIN, min(INT64_MAX, a + b))
+
+
+def safe_sub_clip(a: int, b: int) -> int:
+    return max(INT64_MIN, min(INT64_MAX, a - b))
+
+
+def go_div(a: int, b: int) -> int:
+    """Go int64 division: truncation toward zero (vs Python's floor)."""
+    q = abs(a) // abs(b)
+    return -q if (a < 0) != (b < 0) else q
+
+
+@dataclass
+class Validator:
+    pub_key: PubKey
+    voting_power: int
+    proposer_priority: int = 0
+    address: bytes = field(default=b"")
+
+    def __post_init__(self):
+        if not self.address:
+            self.address = self.pub_key.address()
+
+    def copy(self) -> "Validator":
+        return Validator(
+            self.pub_key, self.voting_power, self.proposer_priority, self.address
+        )
+
+    def bytes(self) -> bytes:
+        """SimpleValidator proto {pub_key=1, voting_power=2} — the merkle
+        leaf of the validator-set hash (types/validator.go:154-170)."""
+        pk = pubkey_to_proto(self.pub_key)
+        return encode_message_field(1, pk) + encode_varint_field(
+            2, self.voting_power
+        )
+
+    def compare_proposer_priority(self, other: Optional["Validator"]) -> "Validator":
+        """Higher priority wins; ties go to the lower address
+        (types/validator.go:101-121)."""
+        if other is None:
+            return self
+        if self.proposer_priority > other.proposer_priority:
+            return self
+        if self.proposer_priority < other.proposer_priority:
+            return other
+        if self.address < other.address:
+            return self
+        if self.address > other.address:
+            return other
+        raise ValueError("cannot compare identical validators")
+
+    def validate_basic(self) -> None:
+        if self.pub_key is None:
+            raise ValueError("validator has nil pubkey")
+        if self.voting_power < 0:
+            raise ValueError("validator has negative voting power")
+        if len(self.address) != 20:
+            raise ValueError(f"validator address must be 20 bytes: {self.address.hex()}")
+
+
+def sort_key_by_voting_power(v: Validator):
+    """ValidatorsByVotingPower: power descending, address ascending
+    (types/validator.go:745-760)."""
+    return (-v.voting_power, v.address)
+
+
+def sort_key_by_address(v: Validator):
+    return v.address

@@ -1,0 +1,119 @@
+"""Device batch verifier vs the ZIP-215 oracle.
+
+All batches here stay within one padded bucket (8) so the suite compiles
+the kernel once (persisted across runs via the repo-local XLA cache).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.ops import curve, field, verify_batch
+from tendermint_tpu.ops.ed25519_batch import _bytes_to_y_sign, _scalars_to_windows
+
+
+def keypair(i):
+    return ref.keypair_from_seed(bytes([i + 1]) * 32)
+
+
+def test_decompress_matches_oracle():
+    pks = [keypair(i)[1] for i in range(6)]
+    pks.append((1).to_bytes(32, "little"))  # identity
+    pks.append((ref.P + 1).to_bytes(32, "little"))  # non-canonical identity
+    raw = np.stack([np.frombuffer(p, dtype=np.uint8) for p in pks])
+    yl, sg = _bytes_to_y_sign(raw)
+    pt, ok = curve.pt_decompress(jnp.asarray(yl), jnp.asarray(sg))
+    assert np.asarray(ok).all()
+    for i, pk in enumerate(pks):
+        o = ref.pt_decompress_liberal(pk)
+        gx = field.limbs_to_int(np.asarray(field.fe_reduce_full(pt[0]))[:, i])
+        gy = field.limbs_to_int(np.asarray(field.fe_reduce_full(pt[1]))[:, i])
+        zo = pow(o[2], ref.P - 2, ref.P)
+        assert gx == o[0] * zo % ref.P and gy == o[1] * zo % ref.P
+
+
+def test_decompress_rejects_off_curve():
+    # y=2 is not on the curve: x^2 = (y^2-1)/(d y^2+1) is non-square
+    assert ref.pt_decompress_liberal((2).to_bytes(32, "little")) is None
+    raw = np.zeros((8, 32), dtype=np.uint8)
+    raw[:, 0] = 2
+    yl, sg = _bytes_to_y_sign(raw)
+    _, ok = curve.pt_decompress(jnp.asarray(yl), jnp.asarray(sg))
+    assert not np.asarray(ok).any()
+
+
+def test_windows_unpack():
+    s = 0xDEADBEEF1234
+    raw = np.frombuffer(s.to_bytes(32, "little"), dtype=np.uint8)[None, :]
+    win = _scalars_to_windows(raw)  # (64, 1) MSB-first
+    recon = 0
+    for i in range(64):
+        recon = recon * 16 + int(win[i, 0])
+    assert recon == s
+
+
+@pytest.fixture(scope="module")
+def batch8():
+    pks, msgs, sigs = [], [], []
+    for i in range(8):
+        priv, pub = keypair(i)
+        msg = b"vote %d" % i
+        pks.append(pub)
+        msgs.append(msg)
+        sigs.append(ref.sign(priv, msg))
+    return pks, msgs, sigs
+
+
+def test_verify_valid_batch(batch8):
+    pks, msgs, sigs = batch8
+    assert verify_batch(pks, msgs, sigs) == [True] * 8
+
+
+def test_verify_flags_bad_entries(batch8):
+    pks, msgs, sigs = (list(x) for x in batch8)
+    sigs[1] = sigs[1][:32] + bytes(32)  # wrong s
+    msgs[3] = b"tampered"  # wrong msg
+    sigs[5] = bytes(32) + sigs[5][32:]  # R replaced by off-curve zero?  y=0 IS on curve
+    pks[6] = keypair(7)[1]  # wrong key
+    got = verify_batch(pks, msgs, sigs)
+    assert got == [True, False, True, False, True, False, False, True]
+
+
+def test_verify_zip215_edge_cases(batch8):
+    pks, msgs, sigs = (list(x) for x in batch8)
+    # identity pubkey: R = [s]B verifies for any msg (small-order accepted)
+    ident = (1).to_bytes(32, "little")
+    s = 12345
+    rb = ref.pt_compress(ref.pt_mul(s, ref.B_POINT))
+    sig215 = rb + s.to_bytes(32, "little")
+    assert ref.verify_zip215_slow(ident, b"x", sig215)
+    pks[0], msgs[0], sigs[0] = ident, b"x", sig215
+    # non-canonical encoding of the same point
+    pks[1], msgs[1], sigs[1] = (ref.P + 1).to_bytes(32, "little"), b"x", sig215
+    # s >= L must be rejected even though the curve equation would hold
+    pks[2], msgs[2], sigs[2] = ident, b"x", rb + (s + ref.L).to_bytes(32, "little")
+    got = verify_batch(pks, msgs, sigs)
+    assert got == [True, True, False, True, True, True, True, True]
+
+
+def test_verify_agrees_with_oracle_on_random_mutations(batch8):
+    pks, msgs, sigs = (list(x) for x in batch8)
+    rng = np.random.RandomState(7)
+    for i in range(8):
+        mode = i % 4
+        if mode == 0:
+            continue  # leave valid
+        b = bytearray(sigs[i])
+        if mode == 1:
+            b[rng.randint(32)] ^= 1 << rng.randint(8)  # corrupt R
+        elif mode == 2:
+            b[32 + rng.randint(31)] ^= 1 << rng.randint(8)  # corrupt s (low bytes)
+        else:
+            pk = bytearray(pks[i])
+            pk[rng.randint(32)] ^= 1 << rng.randint(8)
+            pks[i] = bytes(pk)
+        sigs[i] = bytes(b)
+    want = [ref.verify_zip215(pk, m, s) for pk, m, s in zip(pks, msgs, sigs)]
+    got = verify_batch(pks, msgs, sigs)
+    assert got == want

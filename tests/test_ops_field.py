@@ -1,0 +1,75 @@
+"""Field arithmetic vs Python-int ground truth (runs eagerly on CPU)."""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tendermint_tpu.ops import field
+
+
+def to_arr(vals):
+    return jnp.asarray(
+        np.array([field.int_to_limbs(v) for v in vals], dtype=np.int32).T
+    )
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(1234)
+
+
+def test_mul_add_sub_vs_ints(rng):
+    n = 32
+    xs = [rng.randrange(2**255) for _ in range(n)]
+    ys = [rng.randrange(2**255) for _ in range(n)]
+    X, Y = to_arr(xs), to_arr(ys)
+    mul = np.asarray(field.fe_mul(X, Y))
+    add = np.asarray(field.fe_add(X, Y))
+    sub = np.asarray(field.fe_sub(X, Y))
+    for i in range(n):
+        assert field.limbs_to_int(mul[:, i]) == xs[i] * ys[i] % field.P
+        assert field.limbs_to_int(add[:, i]) == (xs[i] + ys[i]) % field.P
+        assert field.limbs_to_int(sub[:, i]) == (xs[i] - ys[i]) % field.P
+
+
+def test_edge_values():
+    xs = [0, 1, 2, field.P - 1, field.P, field.P + 1, 2**255 - 1, 19, 2**255 - 19]
+    X = to_arr(xs)
+    sq = np.asarray(field.fe_sq(X))
+    red = np.asarray(field.fe_reduce_full(X))
+    for i, x in enumerate(xs):
+        assert field.limbs_to_int(sq[:, i]) == x * x % field.P
+        got = field.limbs_to_int(red[:, i])
+        assert got == x % field.P
+        assert all(0 <= v < 8192 for v in red[:, i])
+
+
+def test_is_zero_and_eq():
+    X = to_arr([0, field.P, 1, 2 * field.P])
+    z = np.asarray(field.fe_is_zero(X))
+    assert list(z) == [True, True, False, True]
+    Y = to_arr([field.P, 0, field.P + 1, 0])
+    eq = np.asarray(field.fe_eq(X, Y))
+    assert list(eq) == [True, True, True, True]
+
+
+def test_pow22523(rng):
+    xs = [rng.randrange(field.P) for _ in range(8)]
+    got = np.asarray(field.fe_pow22523(to_arr(xs)))
+    for i, x in enumerate(xs):
+        assert field.limbs_to_int(got[:, i]) == pow(x, (field.P - 5) // 8, field.P)
+
+
+def test_carry_handles_large_and_negative():
+    # raw limbs outside the invariant (e.g. from subtraction paths)
+    raw = jnp.asarray(
+        np.array([[10_000_000] + [0] * 19, [-5] + [3] * 19], dtype=np.int32).T
+    )
+    out = np.asarray(field.fe_carry(raw))
+    want0 = 10_000_000 % field.P
+    got0 = field.limbs_to_int(out[:, 0])
+    assert got0 == want0
+    want1 = (-5 + sum(3 << (13 * i) for i in range(1, 20))) % field.P
+    assert field.limbs_to_int(out[:, 1]) == want1
