@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Headline benchmark: batched Ed25519 ZIP-215 verification throughput.
+
+Mirrors the reference's BenchmarkVerifyBatch (crypto/ed25519/bench_test.go:31-67)
+at large batch, which is the hot path of VerifyCommit / blocksync / light
+client (types/validation.go:154). Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "sigs/s", "vs_baseline": N}
+
+vs_baseline divides by the reference's Go batch-verify throughput class.
+No Go toolchain exists in this image to measure it directly; the
+denominator is the curve25519-voi batched verify figure of ~33 us/sig on
+a modern x86 core => 30,000 sigs/s (see BASELINE.md: the Go bench "run on
+the build machine is the denominator").
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+GO_CPU_BATCH_SIGS_PER_SEC = 30_000.0  # curve25519-voi batch verify, 1 core
+
+BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+ROUNDS = int(os.environ.get("BENCH_ROUNDS", "5"))
+
+
+def main() -> None:
+    import numpy as np
+
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+    from tendermint_tpu.ops import ed25519_batch
+
+    rng = np.random.default_rng(1234)
+    n_keys = 256  # distinct signers, cycled (commit-like workload)
+    privs = [Ed25519PrivKey.from_seed(bytes(rng.integers(0, 256, 32, dtype=np.uint8))) for _ in range(n_keys)]
+    pubs = [p.pub_key().bytes() for p in privs]
+    msgs = [bytes(rng.integers(0, 256, 120, dtype=np.uint8)) for _ in range(BATCH)]
+    pks = [pubs[i % n_keys] for i in range(BATCH)]
+    sigs = [privs[i % n_keys].sign(msgs[i]) for i in range(BATCH)]
+
+    # Warmup: compile + first run.
+    oks = ed25519_batch.verify_batch(pks, msgs, sigs)
+    assert all(oks), "benchmark signatures must verify"
+
+    best = 0.0
+    for _ in range(ROUNDS):
+        t0 = time.perf_counter()
+        ed25519_batch.verify_batch(pks, msgs, sigs)
+        dt = time.perf_counter() - t0
+        best = max(best, BATCH / dt)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"ed25519_batch_verify_throughput_b{BATCH}",
+                "value": round(best, 1),
+                "unit": "sigs/s",
+                "vs_baseline": round(best / GO_CPU_BATCH_SIGS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

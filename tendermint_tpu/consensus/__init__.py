@@ -1,0 +1,1 @@
+"""BFT consensus engine (reference: internal/consensus/)."""

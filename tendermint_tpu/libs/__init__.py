@@ -1,0 +1,1 @@
+"""Utility libraries (reference: libs/ and internal/libs/)."""

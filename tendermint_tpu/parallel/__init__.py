@@ -1,0 +1,9 @@
+"""Multi-chip parallelism: device meshes and sharded batch verification."""
+
+from tendermint_tpu.parallel.sharding import (
+    make_mesh,
+    sharded_verify_fn,
+    verify_batch_sharded,
+)
+
+__all__ = ["make_mesh", "sharded_verify_fn", "verify_batch_sharded"]

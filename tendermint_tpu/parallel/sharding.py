@@ -1,0 +1,92 @@
+"""Sharded batch verification over a device mesh.
+
+The TPU analog of the reference's task-level concurrency inventory
+(SURVEY.md §2.4): signature lanes are the data-parallel axis. The Straus
+verification kernel (ops/ed25519_batch.py) is lane-local — no
+cross-signature communication — so sharding the lane axis over an ICI
+mesh partitions with zero collectives; XLA emits per-device slices and
+the only sync is the final per-lane bool gather.
+
+For commits larger than one chip's VMEM-friendly batch (100k-validator
+commits, BASELINE.md config 5), this is the scaling path: a
+``Mesh(devices, ('sig',))`` with lanes sharded over 'sig'.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tendermint_tpu.ops import ed25519_batch
+
+SIG_AXIS = "sig"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (default: all)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SIG_AXIS,))
+
+
+@lru_cache(maxsize=8)
+def _sharded_fn_for_mesh(mesh: Mesh):
+    # Shardings by rank: (20, N) and (64, N) shard the trailing lane axis;
+    # (N,) shards its only axis.
+    lane2 = NamedSharding(mesh, P(None, SIG_AXIS))
+    lane1 = NamedSharding(mesh, P(SIG_AXIS))
+    return jax.jit(
+        ed25519_batch.verify_kernel,
+        in_shardings=(lane2, lane1, lane2, lane1, lane2, lane2),
+        out_shardings=lane1,
+    )
+
+
+def sharded_verify_fn(mesh: Mesh):
+    """Jitted verify kernel with lane-axis sharding over ``mesh``."""
+    return _sharded_fn_for_mesh(mesh)
+
+
+def verify_batch_sharded(
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    mesh: Optional[Mesh] = None,
+) -> List[bool]:
+    """Like ops.verify_batch but sharded across every device in ``mesh``.
+
+    Lanes are padded to a multiple of the mesh size times the bucket
+    granularity so each device gets an identical slab.
+    """
+    n = len(pubkeys)
+    if n == 0:
+        return []
+    if mesh is None:
+        mesh = make_mesh()
+    n_dev = mesh.devices.size
+    per_dev = max(8, -(-n // n_dev))  # ceil, min 8 lanes per device
+    # Round per-device lanes up to the bucket table so compile cache hits.
+    per_dev = ed25519_batch._bucket(per_dev)
+    pad_to = per_dev * n_dev
+    inputs, host_ok = ed25519_batch.prepare_batch(pubkeys, msgs, sigs, pad_to=pad_to)
+    fn = _sharded_fn_for_mesh(mesh)
+    device_ok = np.asarray(
+        fn(
+            inputs["a_y"],
+            inputs["a_sign"],
+            inputs["r_y"],
+            inputs["r_sign"],
+            inputs["s_win"],
+            inputs["k_win"],
+        )
+    )[:n]
+    return list(np.logical_and(device_ok, host_ok))

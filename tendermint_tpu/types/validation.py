@@ -1,0 +1,243 @@
+"""Commit verification: the framework's crypto hot path.
+
+Mirrors types/validation.go exactly: ignore/count predicates per entry
+point, tally-then-verify, batch dispatch above a threshold with
+single-verify fallback, and first-bad-signature fault attribution on
+batch failure (validation.go:244-251).
+
+The batch path feeds ``crypto.batch.create_batch_verifier`` which routes
+to the TPU Straus kernel (ops/ed25519_batch.py) for ed25519 — one device
+launch verifies every signature in the commit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.types.block import BlockID, Commit, CommitSig, BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+BATCH_VERIFY_THRESHOLD = 2  # validation.go:12
+
+
+class Fraction(NamedTuple):
+    """libs/math Fraction: unsigned numerator/denominator."""
+
+    numerator: int
+    denominator: int
+
+
+INT64_MAX = 2**63 - 1
+
+
+def _safe_mul(a: int, b: int) -> tuple:
+    """libs/math SafeMul: (result, overflowed) for int64."""
+    r = a * b
+    if r > INT64_MAX or r < -(2**63):
+        return 0, True
+    return r, False
+
+
+class NotEnoughVotingPowerError(Exception):
+    def __init__(self, got: int, needed: int):
+        self.got = got
+        self.needed = needed
+        super().__init__(
+            f"invalid commit -- insufficient voting power: got {got}, needed more than {needed}"
+        )
+
+
+class InvalidCommitError(ValueError):
+    pass
+
+
+def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
+    """validation.go:14-16."""
+    return len(commit.signatures) >= BATCH_VERIFY_THRESHOLD and (
+        crypto_batch.supports_batch_verifier(vals.get_proposer().pub_key)
+    )
+
+
+def verify_commit(
+    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
+) -> None:
+    """validation.go:28-54: +2/3 signed; checks ALL signatures (ABCI apps
+    depend on the full LastCommitInfo for incentivization)."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda c: c.block_id_flag == BLOCK_ID_FLAG_ABSENT
+    count = lambda c: c.block_id_flag == BLOCK_ID_FLAG_COMMIT
+    if _should_batch_verify(vals, commit):
+        return _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count, True, True
+        )
+    return _verify_commit_single(
+        chain_id, vals, commit, voting_power_needed, ignore, count, True, True
+    )
+
+
+def verify_commit_light(
+    chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
+) -> None:
+    """validation.go:58-87: light-client/blocksync variant; stops at +2/3."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda c: c.block_id_flag != BLOCK_ID_FLAG_COMMIT
+    count = lambda c: True
+    if _should_batch_verify(vals, commit):
+        return _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count, False, True
+        )
+    return _verify_commit_single(
+        chain_id, vals, commit, voting_power_needed, ignore, count, False, True
+    )
+
+
+def verify_commit_light_trusting(
+    chain_id: str, vals: ValidatorSet, commit: Commit, trust_level: Fraction
+) -> None:
+    """validation.go:89-135: trustLevel of a DIFFERENT valset signed;
+    lookup is by address, double-signs detected."""
+    if vals is None:
+        raise InvalidCommitError("nil validator set")
+    if trust_level.denominator == 0:
+        raise InvalidCommitError("trustLevel has zero Denominator")
+    if commit is None:
+        raise InvalidCommitError("nil commit")
+    total_mul, overflow = _safe_mul(vals.total_voting_power(), trust_level.numerator)
+    if overflow:
+        raise InvalidCommitError(
+            "int64 overflow while calculating voting power needed"
+        )
+    voting_power_needed = total_mul // trust_level.denominator
+    ignore = lambda c: c.block_id_flag != BLOCK_ID_FLAG_COMMIT
+    count = lambda c: True
+    if _should_batch_verify(vals, commit):
+        return _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count, False, False
+        )
+    return _verify_commit_single(
+        chain_id, vals, commit, voting_power_needed, ignore, count, False, False
+    )
+
+
+def _verify_commit_batch(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+) -> None:
+    """validation.go:151-258."""
+    tallied = 0
+    seen_vals = {}
+    batch_sig_idxs = []
+    bv = crypto_batch.create_batch_verifier(vals.get_proposer().pub_key)
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(commit_sig.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise InvalidCommitError(
+                    f"double vote from validator {val_idx} "
+                    f"({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
+        batch_sig_idxs.append(idx)
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            break
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(got=tallied, needed=voting_power_needed)
+    ok, valid_sigs = bv.verify()
+    if ok:
+        return
+    for i, sig_ok in enumerate(valid_sigs):
+        if not sig_ok:
+            idx = batch_sig_idxs[i]
+            sig = commit.signatures[idx]
+            raise InvalidCommitError(
+                f"wrong signature (#{idx}): {sig.signature.hex().upper()}"
+            )
+    raise InvalidCommitError(
+        "BUG: batch verification failed with no invalid signatures"
+    )
+
+
+def _verify_commit_single(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    ignore_sig: Callable[[CommitSig], bool],
+    count_sig: Callable[[CommitSig], bool],
+    count_all_signatures: bool,
+    look_up_by_index: bool,
+) -> None:
+    """validation.go:262-330."""
+    tallied = 0
+    seen_vals = {}
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(commit_sig.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise InvalidCommitError(
+                    f"double vote from validator {val_idx} "
+                    f"({seen_vals[val_idx]} and {idx})"
+                )
+            seen_vals[val_idx] = idx
+        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        if not val.pub_key.verify_signature(vote_sign_bytes, commit_sig.signature):
+            raise InvalidCommitError(
+                f"wrong signature (#{idx}): {commit_sig.signature.hex().upper()}"
+            )
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            return
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(got=tallied, needed=voting_power_needed)
+
+
+def _verify_basic_vals_and_commit(
+    vals: Optional[ValidatorSet],
+    commit: Optional[Commit],
+    height: int,
+    block_id: BlockID,
+) -> None:
+    """validation.go:334-356."""
+    if vals is None:
+        raise InvalidCommitError("nil validator set")
+    if commit is None:
+        raise InvalidCommitError("nil commit")
+    if len(vals) != len(commit.signatures):
+        raise InvalidCommitError(
+            f"invalid commit -- wrong set size: {len(vals)} vs "
+            f"{len(commit.signatures)}"
+        )
+    if height != commit.height:
+        raise InvalidCommitError(
+            f"invalid commit -- wrong height: {height} vs {commit.height}"
+        )
+    if block_id != commit.block_id:
+        raise InvalidCommitError(
+            f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
+        )

@@ -74,6 +74,47 @@ class Validator:
         if len(self.address) != 20:
             raise ValueError(f"validator address must be 20 bytes: {self.address.hex()}")
 
+    def to_proto_bytes(self) -> bytes:
+        """tendermint.types.Validator {address=1, pub_key=2 non-nullable,
+        voting_power=3, proposer_priority=4} (types/validator.go ToProto)."""
+        from tendermint_tpu.encoding.proto import encode_bytes_field
+
+        return (
+            encode_bytes_field(1, self.address)
+            + encode_message_field(2, pubkey_to_proto(self.pub_key), always=True)
+            + encode_varint_field(3, self.voting_power)
+            + encode_varint_field(4, self.proposer_priority)
+        )
+
+    @classmethod
+    def from_proto_bytes(cls, data: bytes) -> "Validator":
+        from tendermint_tpu.crypto import pubkey_from_proto
+        from tendermint_tpu.encoding.proto import Reader
+
+        r = Reader(data)
+        address = b""
+        pub_key = None
+        voting_power = proposer_priority = 0
+        for f, w in r.fields():
+            if f == 1 and w == 2:
+                address = r.read_bytes()
+            elif f == 2 and w == 2:
+                pub_key = pubkey_from_proto(r.read_bytes())
+            elif f == 3 and w == 0:
+                voting_power = r.read_svarint()
+            elif f == 4 and w == 0:
+                proposer_priority = r.read_svarint()
+            else:
+                r.skip(w)
+        if pub_key is None:
+            raise ValueError("validator proto missing pubkey")
+        out = cls(pub_key, voting_power, proposer_priority, address or b"\x00")
+        # Preserve the wire address verbatim (even empty) so re-serialization
+        # is byte-identical; __post_init__ would otherwise derive it
+        # (reference keeps vp.GetAddress() as-is, validator.go:205).
+        out.address = address
+        return out
+
 
 def sort_key_by_voting_power(v: Validator):
     """ValidatorsByVotingPower: power descending, address ascending

@@ -259,6 +259,50 @@ class ValidatorSet:
             v for v in self.validators if v.address not in delete_addrs
         ]
 
+    def to_proto_bytes(self) -> bytes:
+        """tendermint.types.ValidatorSet {validators=1, proposer=2,
+        total_voting_power=3}. TotalVotingPower is serialized as 0 so proto
+        bytes stay hash-consistent (validator_set.go ToProto)."""
+        from tendermint_tpu.encoding.proto import encode_message_field
+
+        if self.is_nil_or_empty():
+            return b""
+        if self.proposer is None:
+            raise ValueError("nil validator set proposer")
+        out = b""
+        for v in self.validators:
+            out += encode_message_field(1, v.to_proto_bytes(), always=True)
+        out += encode_message_field(2, self.proposer.to_proto_bytes(), always=True)
+        return out
+
+    @classmethod
+    def from_proto_bytes(cls, data: bytes) -> "ValidatorSet":
+        """validator_set.go ValidatorSetFromProto: no change-set algorithm,
+        direct field restore with priorities preserved."""
+        from tendermint_tpu.encoding.proto import Reader
+
+        r = Reader(data)
+        validators: List[Validator] = []
+        proposer: Optional[Validator] = None
+        for f, w in r.fields():
+            if f == 1 and w == 2:
+                validators.append(Validator.from_proto_bytes(r.read_bytes()))
+            elif f == 2 and w == 2:
+                proposer = Validator.from_proto_bytes(r.read_bytes())
+            elif f == 3 and w == 0:
+                r.read_svarint()
+            else:
+                r.skip(w)
+        if proposer is None:
+            raise ValueError("nil validator set proposer")
+        vals = cls.__new__(cls)
+        vals.validators = validators
+        vals.proposer = proposer
+        vals._total_voting_power = None
+        vals._update_total_voting_power()
+        vals.validate_basic()
+        return vals
+
     # --- commit verification (bound in types/validation.py) -----------------
 
     def verify_commit(self, chain_id: str, block_id, height: int, commit) -> None:

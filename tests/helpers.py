@@ -1,0 +1,78 @@
+"""Shared test fixtures: deterministic validator sets and signed commits.
+
+The analog of the reference's types test helpers (types/test_util.go
+makeCommit / deterministicValidatorSet).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Tuple
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.encoding.canonical import Timestamp
+from tendermint_tpu.types import (
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    BlockID,
+    Commit,
+    CommitSig,
+    PartSetHeader,
+    Validator,
+    ValidatorSet,
+)
+
+CHAIN_ID = "test-chain"
+
+
+def make_block_id(seed: bytes = b"block") -> BlockID:
+    h = hashlib.sha256(seed).digest()
+    ph = hashlib.sha256(seed + b"-parts").digest()
+    return BlockID(h, PartSetHeader(1, ph))
+
+
+def make_validators(
+    n: int, power: int = 10
+) -> Tuple[List[Ed25519PrivKey], ValidatorSet]:
+    privs = [Ed25519PrivKey.from_seed(i.to_bytes(32, "big")) for i in range(n)]
+    vals = [Validator(p.pub_key(), power) for p in privs]
+    vset = ValidatorSet(vals)
+    # Sort privkeys to match the canonical validator order (by power desc,
+    # address asc — all powers equal here so address order).
+    by_addr = {p.pub_key().address(): p for p in privs}
+    privs_sorted = [by_addr[v.address] for v in vset.validators]
+    return privs_sorted, vset
+
+
+def make_commit(
+    block_id: BlockID,
+    height: int,
+    round_: int,
+    vset: ValidatorSet,
+    privs: List[Ed25519PrivKey],
+    chain_id: str = CHAIN_ID,
+    absent: Optional[set] = None,
+    nil_votes: Optional[set] = None,
+    time_ns: int = 1_700_000_000_000_000_000,
+) -> Commit:
+    """Sign a precommit for every validator (indices in ``absent`` produce
+    absent CommitSigs; in ``nil_votes``, nil-block precommits)."""
+    absent = absent or set()
+    nil_votes = nil_votes or set()
+    sigs: List[CommitSig] = []
+    commit = Commit(height=height, round=round_, block_id=block_id)
+    for i, val in enumerate(vset.validators):
+        if i in absent:
+            sigs.append(CommitSig.absent())
+            continue
+        flag = BLOCK_ID_FLAG_NIL if i in nil_votes else BLOCK_ID_FLAG_COMMIT
+        ts = Timestamp.from_unix_ns(time_ns + i)
+        cs = CommitSig(flag, val.address, ts, b"")
+        commit.signatures.append(cs)
+        sign_bytes = commit.vote_sign_bytes(chain_id, len(commit.signatures) - 1)
+        cs.signature = privs[i].sign(sign_bytes)
+        commit.signatures.pop()
+        sigs.append(cs)
+    commit.signatures = sigs
+    return commit

@@ -1,0 +1,76 @@
+"""HeightVoteSet tests (internal/consensus/types/height_vote_set_test.go)."""
+
+import pytest
+
+from tendermint_tpu.consensus.cstypes import (
+    GotVoteFromUnwantedRoundError,
+    HeightVoteSet,
+    RoundState,
+    RoundStep,
+)
+from tests.helpers import CHAIN_ID, make_block_id, make_validators
+from tests.test_vote_set import signed_vote
+from tendermint_tpu.encoding.canonical import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+)
+
+
+def test_round_progression_and_pol():
+    privs, vset = make_validators(4, power=1)
+    hvs = HeightVoteSet(CHAIN_ID, 1, vset)
+    bid = make_block_id()
+    hvs.set_round(1)
+    for i in range(4):
+        assert hvs.add_vote(
+            signed_vote(privs[i], vset, i, height=1, round_=1, block_id=bid)
+        )
+    pol_round, pol_bid = hvs.pol_info()
+    assert pol_round == 1 and pol_bid == bid
+    assert hvs.prevotes(1).has_two_thirds_majority()
+    assert hvs.prevotes(0) is not None
+    assert hvs.prevotes(5) is None
+
+
+def test_peer_catchup_round_limit():
+    privs, vset = make_validators(4)
+    hvs = HeightVoteSet(CHAIN_ID, 1, vset)
+    # A peer may introduce at most 2 unexpected rounds.
+    v1 = signed_vote(privs[0], vset, 0, height=1, round_=5, block_id=make_block_id())
+    assert hvs.add_vote(v1, peer_id="peerA")
+    v2 = signed_vote(privs[1], vset, 1, height=1, round_=6, block_id=make_block_id())
+    assert hvs.add_vote(v2, peer_id="peerA")
+    v3 = signed_vote(privs[2], vset, 2, height=1, round_=7, block_id=make_block_id())
+    with pytest.raises(GotVoteFromUnwantedRoundError):
+        hvs.add_vote(v3, peer_id="peerA")
+    # A different peer still has its allowance.
+    assert hvs.add_vote(v3, peer_id="peerB")
+
+
+def test_duplicate_vote_returns_false():
+    privs, vset = make_validators(4)
+    hvs = HeightVoteSet(CHAIN_ID, 1, vset)
+    v = signed_vote(privs[0], vset, 0, height=1, round_=0, block_id=make_block_id())
+    assert hvs.add_vote(v)
+    assert not hvs.add_vote(v)
+
+
+def test_precommits_tracked_separately():
+    privs, vset = make_validators(4)
+    hvs = HeightVoteSet(CHAIN_ID, 1, vset)
+    bid = make_block_id()
+    hvs.add_vote(signed_vote(privs[0], vset, 0, height=1, block_id=bid))
+    hvs.add_vote(
+        signed_vote(
+            privs[0], vset, 0, height=1, type_=SIGNED_MSG_TYPE_PRECOMMIT, block_id=bid
+        )
+    )
+    assert hvs.prevotes(0).get_by_index(0) is not None
+    assert hvs.precommits(0).get_by_index(0) is not None
+
+
+def test_round_state_defaults():
+    rs = RoundState()
+    assert rs.step == RoundStep.NEW_HEIGHT
+    assert rs.locked_round == -1 and rs.valid_round == -1
+    assert rs.height_round_step() == "0/0/1"

@@ -1,0 +1,49 @@
+"""Sharded batch verification over the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.parallel import make_mesh, verify_batch_sharded
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def triples():
+    privs = [Ed25519PrivKey.from_seed(bytes([i]) * 32) for i in range(8)]
+    pks, msgs, sigs = [], [], []
+    for i in range(40):
+        p = privs[i % 8]
+        m = b"sharded-msg-%d" % i
+        pks.append(p.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(p.sign(m))
+    return pks, msgs, sigs
+
+
+def test_all_valid(mesh, triples):
+    pks, msgs, sigs = triples
+    assert all(verify_batch_sharded(pks, msgs, sigs, mesh))
+
+
+def test_bad_lane_isolated(mesh, triples):
+    pks, msgs, sigs = (list(x) for x in triples)
+    sigs[13] = bytes(64)
+    sigs[37] = sigs[36]
+    oks = verify_batch_sharded(pks, msgs, sigs, mesh)
+    expect = [i not in (13, 37) for i in range(len(pks))]
+    assert oks == expect
+
+
+def test_matches_single_device(mesh, triples):
+    from tendermint_tpu.ops import ed25519_batch
+
+    pks, msgs, sigs = (list(x) for x in triples)
+    sigs[5] = bytes(64)
+    assert verify_batch_sharded(pks, msgs, sigs, mesh) == ed25519_batch.verify_batch(
+        pks, msgs, sigs
+    )

@@ -1,0 +1,86 @@
+"""PartSet and BitArray tests (types/part_set_test.go, libs/bits)."""
+
+import os
+
+import pytest
+
+from tendermint_tpu.libs.bits import BitArray
+from tendermint_tpu.types.part_set import Part, PartSet
+
+
+class TestBitArray:
+    def test_set_get(self):
+        ba = BitArray(10)
+        assert not ba.get_index(3)
+        assert ba.set_index(3, True)
+        assert ba.get_index(3)
+        assert not ba.set_index(10, True)  # out of range
+        assert not ba.get_index(10)
+
+    def test_ops(self):
+        a = BitArray.from_indices(8, [0, 1, 2])
+        b = BitArray.from_indices(8, [2, 3])
+        assert a.or_(b).get_true_indices() == [0, 1, 2, 3]
+        assert a.and_(b).get_true_indices() == [2]
+        assert a.sub(b).get_true_indices() == [0, 1]
+        assert a.not_().get_true_indices() == [3, 4, 5, 6, 7]
+
+    def test_full_empty(self):
+        ba = BitArray(9)
+        assert ba.is_empty() and not ba.is_full()
+        for i in range(9):
+            ba.set_index(i, True)
+        assert ba.is_full()
+
+    def test_pick_random(self):
+        ba = BitArray.from_indices(64, [7, 21])
+        idx, ok = ba.pick_random()
+        assert ok and idx in (7, 21)
+        _, ok = BitArray(4).pick_random()
+        assert not ok
+
+
+class TestPartSet:
+    def test_from_data_complete(self):
+        data = os.urandom(5000)
+        ps = PartSet.from_data(data, part_size=1024)
+        assert ps.total == 5
+        assert ps.is_complete()
+        assert ps.get_reader() == data
+
+    def test_incremental_assembly(self):
+        data = os.urandom(5000)
+        src = PartSet.from_data(data, part_size=1024)
+        dst = PartSet(src.header())
+        for i in reversed(range(src.total)):
+            assert dst.add_part(src.get_part(i))
+        assert dst.is_complete()
+        assert dst.get_reader() == data
+
+    def test_duplicate_part_ignored(self):
+        src = PartSet.from_data(os.urandom(3000), part_size=1024)
+        dst = PartSet(src.header())
+        assert dst.add_part(src.get_part(0))
+        assert not dst.add_part(src.get_part(0))
+
+    def test_bad_proof_rejected(self):
+        src = PartSet.from_data(os.urandom(3000), part_size=1024)
+        other = PartSet.from_data(os.urandom(3000), part_size=1024)
+        dst = PartSet(src.header())
+        with pytest.raises(ValueError, match="proof"):
+            dst.add_part(other.get_part(0))
+
+    def test_tampered_bytes_rejected(self):
+        src = PartSet.from_data(os.urandom(3000), part_size=1024)
+        dst = PartSet(src.header())
+        p = src.get_part(1)
+        bad = Part(index=1, bytes=b"\x00" + p.bytes[1:], proof=p.proof)
+        with pytest.raises(ValueError, match="proof"):
+            dst.add_part(bad)
+
+    def test_part_proto_roundtrip(self):
+        src = PartSet.from_data(os.urandom(3000), part_size=1024)
+        p = src.get_part(2)
+        back = Part.from_proto_bytes(p.to_proto_bytes())
+        assert back.index == p.index and back.bytes == p.bytes
+        assert back.proof == p.proof

@@ -1,0 +1,150 @@
+"""VoteSet tests (mirrors types/vote_set_test.go)."""
+
+import pytest
+
+from tendermint_tpu.encoding.canonical import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+    Timestamp,
+)
+from tendermint_tpu.types import BlockID, Vote, verify_commit
+from tendermint_tpu.types.vote_set import (
+    ConflictingVotesError,
+    NonDeterministicSignatureError,
+    VoteSet,
+    VoteSetError,
+)
+from tests.helpers import CHAIN_ID, make_block_id, make_validators
+
+
+def signed_vote(priv, vset, idx, height=1, round_=0, type_=SIGNED_MSG_TYPE_PREVOTE,
+                block_id=None, extension=b""):
+    vote = Vote(
+        type=type_,
+        height=height,
+        round=round_,
+        block_id=block_id if block_id is not None else BlockID(),
+        timestamp=Timestamp.from_unix_ns(1_700_000_000_000_000_000 + idx),
+        validator_address=vset.validators[idx].address,
+        validator_index=idx,
+        extension=extension,
+    )
+    vote.signature = priv.sign(vote.sign_bytes(CHAIN_ID))
+    if extension:
+        vote.extension_signature = priv.sign(vote.extension_sign_bytes(CHAIN_ID))
+    return vote
+
+
+class TestVoteSet:
+    def test_majority_progression(self):
+        privs, vset = make_validators(10, power=1)
+        vs = VoteSet(CHAIN_ID, 1, 0, SIGNED_MSG_TYPE_PREVOTE, vset)
+        bid = make_block_id()
+        # 6 of 10: not yet 2/3 (needs > 6.66 => 7)
+        for i in range(6):
+            assert vs.add_vote(signed_vote(privs[i], vset, i, block_id=bid))
+        assert not vs.has_two_thirds_majority()
+        assert not vs.has_two_thirds_any()
+        assert vs.add_vote(signed_vote(privs[6], vset, 6, block_id=bid))
+        assert vs.has_two_thirds_majority()
+        maj, ok = vs.two_thirds_majority()
+        assert ok and maj == bid
+
+    def test_nil_votes_count_toward_any_not_block(self):
+        privs, vset = make_validators(10, power=1)
+        vs = VoteSet(CHAIN_ID, 1, 0, SIGNED_MSG_TYPE_PREVOTE, vset)
+        bid = make_block_id()
+        for i in range(4):
+            vs.add_vote(signed_vote(privs[i], vset, i, block_id=bid))
+        for i in range(4, 8):
+            vs.add_vote(signed_vote(privs[i], vset, i, block_id=BlockID()))
+        assert vs.has_two_thirds_any()
+        assert not vs.has_two_thirds_majority()
+
+    def test_duplicate_vote_not_added(self):
+        privs, vset = make_validators(4)
+        vs = VoteSet(CHAIN_ID, 1, 0, SIGNED_MSG_TYPE_PREVOTE, vset)
+        v = signed_vote(privs[0], vset, 0, block_id=make_block_id())
+        assert vs.add_vote(v)
+        assert not vs.add_vote(v)
+
+    def test_wrong_step_rejected(self):
+        privs, vset = make_validators(4)
+        vs = VoteSet(CHAIN_ID, 1, 0, SIGNED_MSG_TYPE_PREVOTE, vset)
+        with pytest.raises(VoteSetError, match="unexpected step"):
+            vs.add_vote(signed_vote(privs[0], vset, 0, height=2,
+                                    block_id=make_block_id()))
+
+    def test_bad_signature_rejected(self):
+        privs, vset = make_validators(4)
+        vs = VoteSet(CHAIN_ID, 1, 0, SIGNED_MSG_TYPE_PREVOTE, vset)
+        v = signed_vote(privs[0], vset, 0, block_id=make_block_id())
+        v.signature = bytes(64)
+        with pytest.raises(Exception, match="signature"):
+            vs.add_vote(v)
+
+    def test_conflicting_vote_raises_and_tracked(self):
+        privs, vset = make_validators(4)
+        vs = VoteSet(CHAIN_ID, 1, 0, SIGNED_MSG_TYPE_PREVOTE, vset)
+        v1 = signed_vote(privs[0], vset, 0, block_id=make_block_id(b"a"))
+        v2 = signed_vote(privs[0], vset, 0, block_id=make_block_id(b"b"))
+        assert vs.add_vote(v1)
+        with pytest.raises(ConflictingVotesError) as exc:
+            vs.add_vote(v2)
+        assert exc.value.vote_a.block_id == v1.block_id
+        assert exc.value.vote_b.block_id == v2.block_id
+
+    def test_peer_maj23_allows_conflict_tracking(self):
+        privs, vset = make_validators(4, power=1)
+        vs = VoteSet(CHAIN_ID, 1, 0, SIGNED_MSG_TYPE_PREVOTE, vset)
+        bid_a, bid_b = make_block_id(b"a"), make_block_id(b"b")
+        vs.add_vote(signed_vote(privs[0], vset, 0, block_id=bid_a))
+        vs.set_peer_maj23("peer1", bid_b)
+        # conflicting vote now lands in the tracked block tally
+        with pytest.raises(ConflictingVotesError):
+            vs.add_vote(signed_vote(privs[0], vset, 0, block_id=bid_b))
+        ba = vs.bit_array_by_block_id(bid_b)
+        assert ba is not None and ba.get_index(0)
+
+    def test_make_commit_verifies(self):
+        privs, vset = make_validators(4)
+        vs = VoteSet(CHAIN_ID, 3, 1, SIGNED_MSG_TYPE_PRECOMMIT, vset)
+        bid = make_block_id()
+        for i in range(4):
+            vs.add_vote(
+                signed_vote(privs[i], vset, i, height=3, round_=1,
+                            type_=SIGNED_MSG_TYPE_PRECOMMIT, block_id=bid)
+            )
+        commit = vs.make_commit()
+        assert commit.height == 3 and commit.round == 1
+        verify_commit(CHAIN_ID, vset, bid, 3, commit)
+
+    def test_make_commit_requires_maj23(self):
+        privs, vset = make_validators(4)
+        vs = VoteSet(CHAIN_ID, 3, 1, SIGNED_MSG_TYPE_PRECOMMIT, vset)
+        with pytest.raises(VoteSetError, match=r"\+2/3"):
+            vs.make_commit()
+
+    def test_extended_vote_set_checks_extensions(self):
+        privs, vset = make_validators(4)
+        vs = VoteSet.extended(CHAIN_ID, 3, 0, SIGNED_MSG_TYPE_PRECOMMIT, vset)
+        bid = make_block_id()
+        good = signed_vote(privs[0], vset, 0, height=3,
+                           type_=SIGNED_MSG_TYPE_PRECOMMIT, block_id=bid,
+                           extension=b"ext")
+        assert vs.add_vote(good)
+        bad = signed_vote(privs[1], vset, 1, height=3,
+                          type_=SIGNED_MSG_TYPE_PRECOMMIT, block_id=bid,
+                          extension=b"ext")
+        bad.extension_signature = bytes(64)
+        with pytest.raises(Exception, match="extension"):
+            vs.add_vote(bad)
+
+    def test_plain_vote_set_rejects_extension_data(self):
+        privs, vset = make_validators(4)
+        vs = VoteSet(CHAIN_ID, 3, 0, SIGNED_MSG_TYPE_PRECOMMIT, vset)
+        v = signed_vote(privs[0], vset, 0, height=3,
+                        type_=SIGNED_MSG_TYPE_PRECOMMIT,
+                        block_id=make_block_id(), extension=b"ext")
+        with pytest.raises(VoteSetError, match="extension"):
+            vs.add_vote(v)
