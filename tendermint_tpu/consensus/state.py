@@ -1,0 +1,925 @@
+"""The BFT consensus state machine (internal/consensus/state.go).
+
+One single-threaded ``receive_routine`` drains a queue of peer messages,
+internal (own) messages, and timeouts; every input is WAL-logged before
+processing (own messages fsync'd — state.go:956-970). Round transitions
+follow the reference's enterX graph exactly:
+
+    NewHeight -> NewRound -> Propose -> Prevote -> [PrevoteWait]
+              -> Precommit -> [PrecommitWait] -> Commit -> NewHeight
+
+Gossip I/O is abstracted behind a ``Broadcaster``; the node wires it to
+the p2p reactor, tests wire the validators' queues to each other.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time as _time
+from typing import Callable, List, Optional
+
+from tendermint_tpu.consensus import cstypes
+from tendermint_tpu.consensus.cstypes import HeightVoteSet, RoundStep
+from tendermint_tpu.consensus.ticker import TimeoutTicker
+from tendermint_tpu.consensus.wal import (
+    WAL,
+    BlockPartInfo,
+    EndHeightMessage,
+    MsgInfo,
+    NilWAL,
+    TimeoutInfo,
+)
+from tendermint_tpu.encoding.canonical import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+    Timestamp,
+)
+from tendermint_tpu.privval.base import PrivValidator
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import State as SMState
+from tendermint_tpu.storage.blockstore import BlockStore
+from tendermint_tpu.types.block import (
+    BLOCK_PART_SIZE_BYTES,
+    Block,
+    BlockID,
+    ExtendedCommit,
+    PartSetHeader,
+    Proposal,
+    Vote,
+)
+from tendermint_tpu.types.part_set import Part, PartSet
+from tendermint_tpu.types.vote_set import (
+    ConflictingVotesError,
+    VoteSet,
+    vote_set_from_commit,
+)
+
+
+class Broadcaster:
+    """Outbound gossip seam (the consensus reactor implements this)."""
+
+    def broadcast_proposal(self, proposal: Proposal) -> None: ...
+
+    def broadcast_block_part(self, height: int, round_: int, part: Part) -> None: ...
+
+    def broadcast_vote(self, vote: Vote) -> None: ...
+
+    def broadcast_new_round_step(self, rs) -> None: ...
+
+
+class ConsensusState:
+    """internal/consensus/state.go State."""
+
+    def __init__(
+        self,
+        sm_state: SMState,
+        block_exec: BlockExecutor,
+        block_store: BlockStore,
+        priv_validator: Optional[PrivValidator] = None,
+        wal: Optional[WAL] = None,
+        broadcaster: Optional[Broadcaster] = None,
+        now: Optional[Callable[[], Timestamp]] = None,
+        on_committed: Optional[Callable[[int], None]] = None,
+    ):
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.priv_validator = priv_validator
+        self.priv_pub_key = priv_validator.get_pub_key() if priv_validator else None
+        self.wal = wal or NilWAL()
+        self.broadcaster = broadcaster or Broadcaster()
+        self._now = now or (lambda: Timestamp.from_unix_ns(_time.time_ns()))
+        self.on_committed = on_committed
+
+        self.rs = cstypes.RoundState()
+        self.state = SMState()  # set by _update_to_state
+
+        self.peer_queue: "queue.Queue" = queue.Queue(maxsize=1000)
+        self.internal_queue: "queue.Queue" = queue.Queue(maxsize=1000)
+        self.timeout_queue: "queue.Queue" = queue.Queue(maxsize=100)
+        self.ticker = TimeoutTicker(self.timeout_queue.put)
+
+        self._thread: Optional[threading.Thread] = None
+        self._stop_flag = threading.Event()
+        self._mtx = threading.RLock()
+        self.decide_proposal = self._default_decide_proposal  # test override seam
+
+        self._reconstruct_and_update(sm_state)
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def _reconstruct_and_update(self, sm_state: SMState) -> None:
+        if (
+            sm_state.last_block_height > 0
+            and sm_state.last_block_height >= sm_state.initial_height
+        ):
+            seen = self.block_store.load_seen_commit()
+            if seen is None or seen.height != sm_state.last_block_height:
+                raise RuntimeError(
+                    f"failed to reconstruct last commit; seen commit missing "
+                    f"for height {sm_state.last_block_height}"
+                )
+            self.rs.last_commit = vote_set_from_commit(
+                sm_state.chain_id, seen, sm_state.last_validators
+            )
+        self._update_to_state(sm_state)
+
+    def start(self) -> None:
+        """OnStart (state.go:399): WAL + replay + receive routine + round 0."""
+        self.wal.start()
+        self._catchup_replay()
+        self._stop_flag.clear()
+        self._thread = threading.Thread(
+            target=self._receive_routine, name="consensus-receive", daemon=True
+        )
+        self._thread.start()
+        self._schedule_round_0()
+
+    def stop(self) -> None:
+        self._stop_flag.set()
+        self.ticker.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.wal.stop()
+
+    # --- external inputs ----------------------------------------------------
+
+    def add_vote_from_peer(self, vote: Vote, peer_id: str) -> None:
+        self.peer_queue.put(MsgInfo(vote, peer_id))
+
+    def add_proposal_from_peer(self, proposal: Proposal, peer_id: str) -> None:
+        self.peer_queue.put(MsgInfo(proposal, peer_id))
+
+    def add_block_part_from_peer(
+        self, height: int, round_: int, part: Part, peer_id: str
+    ) -> None:
+        self.peer_queue.put(MsgInfo(BlockPartInfo(height, round_, part), peer_id))
+
+    def _send_internal(self, msg_info: MsgInfo) -> None:
+        self.internal_queue.put(msg_info)
+
+    # --- the single-threaded loop -------------------------------------------
+
+    def _receive_routine(self) -> None:
+        """state.go:888-991: WAL-before-process; internal msgs fsync'd.
+        Timeouts are drained every iteration so peer traffic cannot starve
+        round progression (the Go select is fair across all channels)."""
+        while not self._stop_flag.is_set():
+            processed = False
+            # Timeouts first: rare, cheap, and liveness-critical.
+            try:
+                while True:
+                    ti = self.timeout_queue.get_nowait()
+                    with self._mtx:
+                        self.wal.write(ti)
+                        self._handle_timeout(ti)
+                    processed = True
+            except queue.Empty:
+                pass
+            try:
+                mi = self.internal_queue.get_nowait()
+                with self._mtx:
+                    self.wal.write_sync(mi)  # fsync own messages (state.go:964)
+                    self._handle_msg(mi)
+                processed = True
+            except queue.Empty:
+                pass
+            if not processed:
+                try:
+                    mi = self.peer_queue.get_nowait()
+                    with self._mtx:
+                        self.wal.write(mi)
+                        # Peer input must never kill the loop: malformed
+                        # messages are dropped (state.go handleMsg logs
+                        # and continues).
+                        try:
+                            self._handle_msg(mi)
+                        except Exception:
+                            pass
+                    processed = True
+                except queue.Empty:
+                    pass
+            if not processed:
+                _time.sleep(0.002)
+
+    def _handle_msg(self, mi: MsgInfo) -> None:
+        msg = mi.msg
+        if isinstance(msg, Proposal):
+            self._set_proposal(msg, self._now())
+        elif isinstance(msg, BlockPartInfo):
+            added = self._add_proposal_block_part(msg, mi.peer_id)
+            if added and self.rs.proposal_block_parts.is_complete():
+                self._handle_complete_proposal()
+        elif isinstance(msg, Vote):
+            self._try_add_vote(msg, mi.peer_id)
+        else:
+            raise TypeError(f"unknown msg type {type(msg)}")
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """state.go:1035-1090: stale filter + dispatch."""
+        rs = self.rs
+        if (
+            ti.height != rs.height
+            or ti.round < rs.round
+            or (ti.round == rs.round and ti.step < rs.step)
+        ):
+            return
+        if ti.step == RoundStep.NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == RoundStep.NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif ti.step == RoundStep.PROPOSE:
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == RoundStep.PREVOTE_WAIT:
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == RoundStep.PRECOMMIT_WAIT:
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+
+    # --- state update -------------------------------------------------------
+
+    def _update_to_state(self, sm_state: SMState) -> None:
+        """state.go updateToState (abridged faithfully)."""
+        rs = self.rs
+        if not self.state.is_empty() and (
+            sm_state.last_block_height <= self.state.last_block_height
+        ):
+            self._new_step()
+            return
+
+        if sm_state.last_block_height == 0:
+            rs.last_commit = None
+        elif rs.commit_round > -1 and rs.votes is not None:
+            precommits = rs.votes.precommits(rs.commit_round)
+            if precommits is None or not precommits.has_two_thirds_majority():
+                raise RuntimeError(
+                    "wanted to form a commit, but precommits didn't have 2/3+"
+                )
+            rs.last_commit = precommits
+
+        height = sm_state.last_block_height + 1
+        if height == 1:
+            height = sm_state.initial_height
+
+        rs.height = height
+        rs.round = 0
+        rs.step = RoundStep.NEW_HEIGHT
+        commit_base = (
+            rs.commit_time
+            if rs.commit_time.to_unix_ns() and rs.commit_time != cstypes.GO_ZERO_TIME
+            else self._now()
+        )
+        rs.start_time = Timestamp.from_unix_ns(
+            commit_base.to_unix_ns()
+            + int(sm_state.consensus_params.timeout.commit * 1e9)
+        )
+        rs.validators = sm_state.validators
+        rs.proposal = None
+        rs.proposal_receive_time = cstypes.GO_ZERO_TIME
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        if sm_state.consensus_params.abci.vote_extensions_enabled(height):
+            rs.votes = HeightVoteSet.extended(
+                sm_state.chain_id, height, sm_state.validators
+            )
+        else:
+            rs.votes = HeightVoteSet(sm_state.chain_id, height, sm_state.validators)
+        rs.commit_round = -1
+        rs.last_validators = sm_state.last_validators
+        rs.triggered_timeout_precommit = False
+        self.state = sm_state
+        self._new_step()
+
+    def _new_step(self) -> None:
+        self.broadcaster.broadcast_new_round_step(self.rs)
+
+    def _schedule_round_0(self) -> None:
+        delay = max(
+            0.0, (self.rs.start_time.to_unix_ns() - self._now().to_unix_ns()) / 1e9
+        )
+        self.ticker.schedule_timeout(
+            delay, self.rs.height, 0, RoundStep.NEW_HEIGHT
+        )
+
+    # --- round transitions ---------------------------------------------------
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        """state.go:1178-1253."""
+        rs = self.rs
+        if (
+            rs.height != height
+            or round_ < rs.round
+            or (rs.round == round_ and rs.step != RoundStep.NEW_HEIGHT)
+        ):
+            return
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+        rs.round = round_
+        rs.step = RoundStep.NEW_ROUND
+        rs.validators = validators
+        if round_ != 0:
+            rs.proposal = None
+            rs.proposal_receive_time = cstypes.GO_ZERO_TIME
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_ + 1)  # track next round for round-skipping
+        rs.triggered_timeout_precommit = False
+        self._enter_propose(height, round_)
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        """state.go:1273-1351."""
+        rs = self.rs
+        if (
+            rs.height != height
+            or round_ < rs.round
+            or (rs.round == round_ and rs.step >= RoundStep.PROPOSE)
+        ):
+            return
+        try:
+            # Schedule prevote-on-timeout before doing anything slow.
+            self.ticker.schedule_timeout(
+                self.state.consensus_params.timeout.propose_timeout(round_),
+                height,
+                round_,
+                RoundStep.PROPOSE,
+            )
+            if self.priv_validator is None or self.priv_pub_key is None:
+                return
+            addr = self.priv_pub_key.address()
+            if not rs.validators.has_address(addr):
+                return
+            if self._is_proposer(addr):
+                self.decide_proposal(height, round_)
+        finally:
+            rs.round = round_
+            rs.step = RoundStep.PROPOSE
+            self._new_step()
+            if self._is_proposal_complete():
+                self._enter_prevote(height, rs.round)
+
+    def _is_proposer(self, address: bytes) -> bool:
+        return self.rs.validators.get_proposer().address == address
+
+    def _default_decide_proposal(self, height: int, round_: int) -> None:
+        """state.go:1353-1409."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            block = self._create_proposal_block()
+            if block is None:
+                return
+            block_parts = PartSet.from_data(
+                block.to_proto_bytes(), BLOCK_PART_SIZE_BYTES
+            )
+        self.wal.flush_and_sync()
+        prop_block_id = BlockID(block.hash(), block_parts.header())
+        proposal = Proposal(
+            height=height,
+            round=round_,
+            pol_round=rs.valid_round,
+            block_id=prop_block_id,
+            timestamp=block.header.time,
+        )
+        try:
+            self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception:
+            return
+        self._send_internal(MsgInfo(proposal, ""))
+        for i in range(block_parts.total):
+            self._send_internal(
+                MsgInfo(BlockPartInfo(rs.height, rs.round, block_parts.get_part(i)), "")
+            )
+        self.broadcaster.broadcast_proposal(proposal)
+        for i in range(block_parts.total):
+            self.broadcaster.broadcast_block_part(
+                rs.height, rs.round, block_parts.get_part(i)
+            )
+
+    def _create_proposal_block(self) -> Optional[Block]:
+        """state.go:1428-1477."""
+        rs = self.rs
+        if rs.height == self.state.initial_height:
+            last_ext_commit = ExtendedCommit()
+        elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+            last_ext_commit = rs.last_commit.make_extended_commit()
+        else:
+            return None
+        proposer_addr = self.priv_pub_key.address()
+        return self.block_exec.create_proposal_block(
+            rs.height, self.state, last_ext_commit, proposer_addr
+        )
+
+    def _is_proposal_complete(self) -> bool:
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        """state.go:1478-1510."""
+        rs = self.rs
+        if (
+            rs.height != height
+            or round_ < rs.round
+            or (rs.round == round_ and rs.step >= RoundStep.PREVOTE)
+        ):
+            return
+        self._do_prevote(height, round_)
+        rs.round = round_
+        rs.step = RoundStep.PREVOTE
+        self._new_step()
+
+    def _proposal_is_timely(self) -> bool:
+        rs = self.rs
+        sp = self.state.consensus_params.synchrony.in_round(rs.round)
+        ts = rs.proposal.timestamp.to_unix_ns()
+        recv = rs.proposal_receive_time.to_unix_ns()
+        lhs = ts - int(sp.precision * 1e9)
+        rhs = ts + int(sp.message_delay * 1e9) + int(sp.precision * 1e9)
+        return lhs <= recv <= rhs
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        """state.go defaultDoPrevote:1512-1645 (PBTS checks included)."""
+        rs = self.rs
+        if rs.proposal_block is None or rs.proposal is None:
+            self._sign_add_vote(SIGNED_MSG_TYPE_PREVOTE, b"", PartSetHeader())
+            return
+        if rs.proposal.timestamp != rs.proposal_block.header.time:
+            self._sign_add_vote(SIGNED_MSG_TYPE_PREVOTE, b"", PartSetHeader())
+            return
+        if (
+            rs.proposal.pol_round == -1
+            and rs.locked_round == -1
+            and not self._proposal_is_timely()
+        ):
+            self._sign_add_vote(SIGNED_MSG_TYPE_PREVOTE, b"", PartSetHeader())
+            return
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+        except ValueError:
+            self._sign_add_vote(SIGNED_MSG_TYPE_PREVOTE, b"", PartSetHeader())
+            return
+        if not self.block_exec.process_proposal(rs.proposal_block, self.state):
+            self._sign_add_vote(SIGNED_MSG_TYPE_PREVOTE, b"", PartSetHeader())
+            return
+
+        if rs.proposal.pol_round == -1:
+            if rs.locked_round == -1 or (
+                rs.locked_block is not None
+                and rs.proposal_block.hash() == rs.locked_block.hash()
+            ):
+                self._sign_add_vote(
+                    SIGNED_MSG_TYPE_PREVOTE,
+                    rs.proposal_block.hash(),
+                    rs.proposal_block_parts.header(),
+                )
+                return
+        else:
+            prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+            if prevotes is not None:
+                block_id, ok = prevotes.two_thirds_majority()
+                if (
+                    ok
+                    and rs.proposal_block.hash() == block_id.hash
+                    and 0 <= rs.proposal.pol_round < rs.round
+                ):
+                    if rs.locked_round <= rs.proposal.pol_round or (
+                        rs.locked_block is not None
+                        and rs.proposal_block.hash() == rs.locked_block.hash()
+                    ):
+                        self._sign_add_vote(
+                            SIGNED_MSG_TYPE_PREVOTE,
+                            rs.proposal_block.hash(),
+                            rs.proposal_block_parts.header(),
+                        )
+                        return
+        self._sign_add_vote(SIGNED_MSG_TYPE_PREVOTE, b"", PartSetHeader())
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if (
+            rs.height != height
+            or round_ < rs.round
+            or (rs.round == round_ and rs.step >= RoundStep.PREVOTE_WAIT)
+        ):
+            return
+        rs.round = round_
+        rs.step = RoundStep.PREVOTE_WAIT
+        self._new_step()
+        self.ticker.schedule_timeout(
+            self.state.consensus_params.timeout.vote_timeout(round_),
+            height,
+            round_,
+            RoundStep.PREVOTE_WAIT,
+        )
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """state.go:1682-1798."""
+        rs = self.rs
+        if (
+            rs.height != height
+            or round_ < rs.round
+            or (rs.round == round_ and rs.step >= RoundStep.PRECOMMIT)
+        ):
+            return
+        try:
+            prevotes = rs.votes.prevotes(round_)
+            block_id, ok = (
+                prevotes.two_thirds_majority() if prevotes else (BlockID(), False)
+            )
+            if not ok:
+                self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
+                return
+            if block_id.is_nil():
+                self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
+                return
+            if rs.proposal is None or rs.proposal_block is None:
+                self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
+                return
+            if rs.proposal.timestamp != rs.proposal_block.header.time:
+                self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
+                return
+            if (
+                rs.locked_block is not None
+                and rs.locked_block.hash() == block_id.hash
+            ):
+                rs.locked_round = round_
+                self._sign_add_vote(
+                    SIGNED_MSG_TYPE_PRECOMMIT, block_id.hash, block_id.part_set_header
+                )
+                return
+            if rs.proposal_block.hash() == block_id.hash:
+                self.block_exec.validate_block(self.state, rs.proposal_block)
+                rs.locked_round = round_
+                rs.locked_block = rs.proposal_block
+                rs.locked_block_parts = rs.proposal_block_parts
+                self._sign_add_vote(
+                    SIGNED_MSG_TYPE_PRECOMMIT, block_id.hash, block_id.part_set_header
+                )
+                return
+            # Polka for a block we don't have: fetch it, precommit nil.
+            if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                block_id.part_set_header
+            ):
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet(block_id.part_set_header)
+            self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
+        finally:
+            rs.round = round_
+            rs.step = RoundStep.PRECOMMIT
+            self._new_step()
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            round_ == rs.round and rs.triggered_timeout_precommit
+        ):
+            return
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self.ticker.schedule_timeout(
+            self.state.consensus_params.timeout.vote_timeout(round_),
+            height,
+            round_,
+            RoundStep.PRECOMMIT_WAIT,
+        )
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        """state.go:1837-1902."""
+        rs = self.rs
+        if rs.height != height or rs.step >= RoundStep.COMMIT:
+            return
+        try:
+            precommits = rs.votes.precommits(commit_round)
+            block_id, ok = precommits.two_thirds_majority()
+            if not ok:
+                raise RuntimeError("enterCommit expects +2/3 precommits")
+            if (
+                rs.locked_block is not None
+                and rs.locked_block.hash() == block_id.hash
+            ):
+                rs.proposal_block = rs.locked_block
+                rs.proposal_block_parts = rs.locked_block_parts
+            if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+                if (
+                    rs.proposal_block_parts is None
+                    or not rs.proposal_block_parts.has_header(block_id.part_set_header)
+                ):
+                    rs.proposal_block = None
+                    rs.proposal_block_parts = PartSet(block_id.part_set_header)
+        finally:
+            rs.step = RoundStep.COMMIT
+            rs.commit_round = commit_round
+            rs.commit_time = self._now()
+            self._new_step()
+            self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        rs = self.rs
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, ok = precommits.two_thirds_majority()
+        if not ok or block_id.is_nil():
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            return
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """state.go:1931-2040."""
+        rs = self.rs
+        if rs.height != height or rs.step != RoundStep.COMMIT:
+            return
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id, ok = precommits.two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+        if not ok:
+            raise RuntimeError("cannot finalize commit; no 2/3 majority")
+        if not block_parts.has_header(block_id.part_set_header):
+            raise RuntimeError("expected ProposalBlockParts header to match commit")
+        if block.hash() != block_id.hash:
+            raise RuntimeError("cannot finalize commit; block hash mismatch")
+        self.block_exec.validate_block(self.state, block)
+
+        if self.block_store.height() < block.header.height:
+            seen_ec = precommits.make_extended_commit()
+            if self.state.consensus_params.abci.vote_extensions_enabled(
+                block.header.height
+            ):
+                self.block_store.save_block_with_extended_commit(
+                    block, block_parts, seen_ec
+                )
+            else:
+                self.block_store.save_block(block, block_parts, seen_ec.to_commit())
+
+        # WAL end-height marker AFTER the block is durably stored.
+        self.wal.write_sync(EndHeightMessage(height))
+
+        state_copy = self.state.copy()
+        state_copy = self.block_exec.apply_block(
+            state_copy, BlockID(block.hash(), block_parts.header()), block
+        )
+        self._update_to_state(state_copy)
+        if self.priv_validator is not None:
+            self.priv_pub_key = self.priv_validator.get_pub_key()
+        if self.on_committed is not None:
+            self.on_committed(height)
+        self._schedule_round_0()
+
+    # --- proposal/part/vote ingestion ----------------------------------------
+
+    def _set_proposal(self, proposal: Proposal, recv_time: Timestamp) -> None:
+        """state.go defaultSetProposal:2130-2175."""
+        rs = self.rs
+        if rs.proposal is not None or proposal is None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+            0 <= proposal.pol_round and proposal.pol_round >= proposal.round
+        ):
+            raise ValueError("invalid proposal POL round")
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify_signature(
+            proposal.sign_bytes(self.state.chain_id), proposal.signature
+        ):
+            raise ValueError("invalid proposal signature")
+        rs.proposal = proposal
+        rs.proposal_receive_time = recv_time
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(proposal.block_id.part_set_header)
+
+    def _add_proposal_block_part(self, msg: BlockPartInfo, peer_id: str) -> bool:
+        """state.go:2179-2254."""
+        rs = self.rs
+        if rs.height != msg.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if not added:
+            return False
+        if rs.proposal_block_parts.byte_size > self.state.consensus_params.block.max_bytes:
+            raise ValueError("total size of proposal block parts exceeds max block bytes")
+        if rs.proposal_block_parts.is_complete():
+            rs.proposal_block = Block.from_proto_bytes(
+                rs.proposal_block_parts.get_reader()
+            )
+        return added
+
+    def _handle_complete_proposal(self) -> None:
+        """state.go handleCompleteProposal:2255-2287."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(rs.round)
+        block_id, has_maj = (
+            prevotes.two_thirds_majority() if prevotes else (BlockID(), False)
+        )
+        if has_maj and not block_id.is_nil() and rs.valid_round < rs.round:
+            if rs.proposal_block.hash() == block_id.hash:
+                rs.valid_round = rs.round
+                rs.valid_block = rs.proposal_block
+                rs.valid_block_parts = rs.proposal_block_parts
+        if rs.step <= RoundStep.PROPOSE and self._is_proposal_complete():
+            self._enter_prevote(rs.height, rs.round)
+            if has_maj:
+                self._enter_precommit(rs.height, rs.round)
+        elif rs.step == RoundStep.COMMIT:
+            self._try_finalize_commit(rs.height)
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """state.go tryAddVote:2289 + addVote:2333."""
+        try:
+            return self._add_vote(vote, peer_id)
+        except ConflictingVotesError as e:
+            if (
+                self.priv_pub_key is not None
+                and vote.validator_address == self.priv_pub_key.address()
+            ):
+                return False
+            pool = getattr(self.block_exec, "evidence_pool", None)
+            if pool is not None and hasattr(pool, "report_conflicting_votes"):
+                pool.report_conflicting_votes(e.vote_a, e.vote_b)
+            return False
+        except Exception:
+            return False
+
+    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        rs = self.rs
+
+        # Precommit for the previous height while in NewHeight step.
+        if vote.height + 1 == rs.height and vote.type == SIGNED_MSG_TYPE_PRECOMMIT:
+            if rs.step != RoundStep.NEW_HEIGHT:
+                return False
+            if rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if added and (
+                self.state.consensus_params.timeout.bypass_commit_timeout
+                and rs.last_commit.has_all()
+            ):
+                self._enter_new_round(rs.height, 0)
+            return added
+
+        if vote.height != rs.height:
+            return False
+
+        if self.state.consensus_params.abci.vote_extensions_enabled(rs.height):
+            my_addr = self.priv_pub_key.address() if self.priv_pub_key else b""
+            if (
+                vote.type == SIGNED_MSG_TYPE_PRECOMMIT
+                and not vote.block_id.is_nil()
+                and vote.validator_address != my_addr
+            ):
+                val = self.state.validators.get_by_index(vote.validator_index)
+                vote.verify_extension(self.state.chain_id, val.pub_key)
+                self.block_exec.verify_vote_extension(vote)
+        else:
+            vote.strip_extension()
+
+        height = rs.height
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+
+        if vote.type == SIGNED_MSG_TYPE_PREVOTE:
+            prevotes = rs.votes.prevotes(vote.round)
+            block_id, ok = prevotes.two_thirds_majority()
+            if ok and not block_id.is_nil():
+                if rs.valid_round < vote.round and vote.round == rs.round:
+                    if (
+                        rs.proposal_block is not None
+                        and rs.proposal_block.hash() == block_id.hash
+                    ):
+                        rs.valid_round = vote.round
+                        rs.valid_block = rs.proposal_block
+                        rs.valid_block_parts = rs.proposal_block_parts
+                    else:
+                        rs.proposal_block = None
+                    if (
+                        rs.proposal_block_parts is None
+                        or not rs.proposal_block_parts.has_header(
+                            block_id.part_set_header
+                        )
+                    ):
+                        rs.proposal_block_parts = PartSet(block_id.part_set_header)
+            if rs.round < vote.round and prevotes.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+            elif rs.round == vote.round and rs.step >= RoundStep.PREVOTE:
+                block_id, ok = prevotes.two_thirds_majority()
+                if ok and (self._is_proposal_complete() or block_id.is_nil()):
+                    self._enter_precommit(height, vote.round)
+                elif prevotes.has_two_thirds_any():
+                    self._enter_prevote_wait(height, vote.round)
+            elif (
+                rs.proposal is not None
+                and 0 <= rs.proposal.pol_round == vote.round
+            ):
+                if self._is_proposal_complete():
+                    self._enter_prevote(height, rs.round)
+        elif vote.type == SIGNED_MSG_TYPE_PRECOMMIT:
+            precommits = rs.votes.precommits(vote.round)
+            block_id, ok = precommits.two_thirds_majority()
+            if ok:
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit(height, vote.round)
+                if not block_id.is_nil():
+                    self._enter_commit(height, vote.round)
+                    if (
+                        self.state.consensus_params.timeout.bypass_commit_timeout
+                        and precommits.has_all()
+                    ):
+                        self._enter_new_round(rs.height, 0)
+                else:
+                    self._enter_precommit_wait(height, vote.round)
+            elif rs.round <= vote.round and precommits.has_two_thirds_any():
+                self._enter_new_round(height, vote.round)
+                self._enter_precommit_wait(height, vote.round)
+        return True
+
+    # --- vote signing --------------------------------------------------------
+
+    def _sign_vote(
+        self, msg_type: int, hash_: bytes, header: PartSetHeader
+    ) -> Optional[Vote]:
+        """state.go signVote:2540-2620."""
+        self.wal.flush_and_sync()
+        if self.priv_pub_key is None:
+            return None
+        addr = self.priv_pub_key.address()
+        val_idx, _ = self.rs.validators.get_by_address(addr)
+        if val_idx < 0:
+            return None
+        rs = self.rs
+        vote = Vote(
+            type=msg_type,
+            height=rs.height,
+            round=rs.round,
+            block_id=BlockID(hash_, header),
+            timestamp=self._vote_time(),
+            validator_address=addr,
+            validator_index=val_idx,
+        )
+        ext_enabled = self.state.consensus_params.abci.vote_extensions_enabled(
+            rs.height
+        )
+        if msg_type == SIGNED_MSG_TYPE_PRECOMMIT and hash_ and ext_enabled:
+            vote.extension = self.block_exec.extend_vote(vote)
+        self.priv_validator.sign_vote(self.state.chain_id, vote)
+        if not ext_enabled:
+            vote.strip_extension()
+        return vote
+
+    def _vote_time(self) -> Timestamp:
+        return self._now()
+
+    def _sign_add_vote(
+        self, msg_type: int, hash_: bytes, header: PartSetHeader
+    ) -> Optional[Vote]:
+        if self.priv_validator is None or self.priv_pub_key is None:
+            return None
+        if not self.rs.validators.has_address(self.priv_pub_key.address()):
+            return None
+        try:
+            vote = self._sign_vote(msg_type, hash_, header)
+        except Exception:
+            return None
+        if vote is None:
+            return None
+        self._send_internal(MsgInfo(vote, ""))
+        self.broadcaster.broadcast_vote(vote)
+        return vote
+
+    # --- WAL replay ----------------------------------------------------------
+
+    def _catchup_replay(self) -> None:
+        """replay.go catchupReplay:97-180: replay WAL messages for the
+        current height after the last end-height marker."""
+        height = self.rs.height
+        offset = self.wal.search_for_end_height(height - 1)
+        if offset is None and height > self.state.initial_height:
+            offset = 0
+        start = offset or 0
+        for _, msg in self.wal.iter_messages(start):
+            if isinstance(msg, EndHeightMessage):
+                continue
+            if isinstance(msg, MsgInfo):
+                with self._mtx:
+                    try:
+                        self._handle_msg(msg)
+                    except Exception:
+                        pass
+            elif isinstance(msg, TimeoutInfo):
+                with self._mtx:
+                    self._handle_timeout(msg)

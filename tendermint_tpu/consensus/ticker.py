@@ -1,0 +1,48 @@
+"""TimeoutTicker (internal/consensus/ticker.go): one timer, HRS-monotonic.
+
+ScheduleTimeout replaces any pending timer; a fire enqueues the
+TimeoutInfo onto the state machine's timeout queue. Stale timeouts (for
+an older height/round/step) are filtered by the receiver, as in the
+reference (ticker.go:18-50 + state.go handleTimeout guard).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from tendermint_tpu.consensus.wal import TimeoutInfo
+
+
+class TimeoutTicker:
+    def __init__(self, on_timeout: Callable[[TimeoutInfo], None]):
+        self._on_timeout = on_timeout
+        self._timer: Optional[threading.Timer] = None
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def schedule_timeout(
+        self, duration: float, height: int, round_: int, step: int
+    ) -> None:
+        ti = TimeoutInfo(duration, height, round_, step)
+        with self._lock:
+            if self._stopped:
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(max(0.0, duration), self._fire, args=(ti,))
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _fire(self, ti: TimeoutInfo) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+        self._on_timeout(ti)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None

@@ -1,0 +1,7 @@
+"""Validator signing: local file-backed signer with double-sign
+protection (reference: privval/)."""
+
+from tendermint_tpu.privval.file_pv import FilePV, DoubleSignError
+from tendermint_tpu.privval.base import PrivValidator
+
+__all__ = ["DoubleSignError", "FilePV", "PrivValidator"]

@@ -1,0 +1,19 @@
+"""PrivValidator interface (types/priv_validator.go:28)."""
+
+from __future__ import annotations
+
+from tendermint_tpu.crypto.keys import PubKey
+from tendermint_tpu.types.block import Proposal, Vote
+
+
+class PrivValidator:
+    def get_pub_key(self) -> PubKey:
+        raise NotImplementedError
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        """Sets vote.signature (and extension signature for non-nil
+        precommits); raises on double-sign risk."""
+        raise NotImplementedError
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        raise NotImplementedError

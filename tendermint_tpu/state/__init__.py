@@ -1,0 +1,7 @@
+"""Deterministic chain state and block execution
+(reference: internal/state/)."""
+
+from tendermint_tpu.state.state import State, state_from_genesis
+from tendermint_tpu.state.store import StateStore
+
+__all__ = ["State", "StateStore", "state_from_genesis"]

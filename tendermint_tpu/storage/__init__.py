@@ -1,0 +1,6 @@
+"""Persistence: key-value abstraction, block store, state store
+(reference: tm-db, internal/store/, internal/state/store.go)."""
+
+from tendermint_tpu.storage.kv import Batch, KVStore, MemDB
+
+__all__ = ["Batch", "KVStore", "MemDB"]

@@ -794,10 +794,12 @@ class Data:
     _hash: Optional[bytes] = dc_field(default=None, repr=False, compare=False)
 
     def hash(self) -> bytes:
-        """Merkle root over raw txs (types/tx.go Txs.Hash uses tx bytes as
-        leaves)."""
+        """Merkle root over per-tx SHA-256 hashes (types/tx.go Txs.Hash:
+        leaf_i = sha256(tx_i), then HashFromByteSlices)."""
         if self._hash is None:
-            self._hash = merkle.hash_from_byte_slices(list(self.txs))
+            self._hash = merkle.hash_from_byte_slices(
+                [tx_hash(tx) for tx in self.txs]
+            )
         return self._hash
 
     def to_proto_bytes(self) -> bytes:

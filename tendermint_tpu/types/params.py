@@ -192,4 +192,169 @@ class ConsensusParamsUpdate:
     abci: Optional[ABCIParams] = None
 
 
-DEFAULT_CONSENSUS_PARAMS = ConsensusParams
+def default_consensus_params() -> ConsensusParams:
+    """Fresh defaults (types/params.go DefaultConsensusParams). A function,
+    not a shared instance: ConsensusParams is mutable."""
+    return ConsensusParams()
+
+
+# --- proto encoding (tendermint.types.ConsensusParams) ----------------------
+#
+# Field layout follows proto/tendermint/types/params.proto: block=1,
+# evidence=2, validator=3, version=4, synchrony=5, timeout=6, abci=7.
+# Durations are google.protobuf.Duration {seconds=1, nanos=2}; host-side
+# floats are converted at the boundary.
+
+
+def _encode_duration(seconds_float: float) -> bytes:
+    from tendermint_tpu.encoding.proto import encode_varint_field as evf
+
+    total_ns = round(seconds_float * 1e9)
+    secs, nanos = divmod(total_ns, 1_000_000_000)
+    return evf(1, secs) + evf(2, nanos)
+
+
+def _decode_duration(data: bytes) -> float:
+    from tendermint_tpu.encoding.proto import Reader
+
+    r = Reader(data)
+    secs = nanos = 0
+    for f, w in r.fields():
+        if f == 1 and w == 0:
+            secs = r.read_svarint()
+        elif f == 2 and w == 0:
+            nanos = r.read_svarint()
+        else:
+            r.skip(w)
+    return secs + nanos / 1e9
+
+
+def consensus_params_to_proto_bytes(p: "ConsensusParams") -> bytes:
+    from tendermint_tpu.encoding.proto import (
+        encode_bool_field,
+        encode_bytes_field,
+        encode_message_field,
+        encode_varint_field as evf,
+    )
+
+    block = evf(1, p.block.max_bytes) + evf(2, p.block.max_gas)
+    evidence = (
+        evf(1, p.evidence.max_age_num_blocks)
+        + encode_message_field(2, _encode_duration(p.evidence.max_age_duration), always=True)
+        + evf(3, p.evidence.max_bytes)
+    )
+    validator = b"".join(
+        encode_bytes_field(1, kt.encode()) for kt in p.validator.pub_key_types
+    )
+    version = evf(1, p.version.app_version)
+    synchrony = encode_message_field(
+        1, _encode_duration(p.synchrony.precision)
+    ) + encode_message_field(2, _encode_duration(p.synchrony.message_delay))
+    timeout = (
+        encode_message_field(1, _encode_duration(p.timeout.propose))
+        + encode_message_field(2, _encode_duration(p.timeout.propose_delta))
+        + encode_message_field(3, _encode_duration(p.timeout.vote))
+        + encode_message_field(4, _encode_duration(p.timeout.vote_delta))
+        + encode_message_field(5, _encode_duration(p.timeout.commit))
+        + encode_bool_field(6, p.timeout.bypass_commit_timeout)
+    )
+    abci = evf(1, p.abci.vote_extensions_enable_height)
+    return (
+        encode_message_field(1, block, always=True)
+        + encode_message_field(2, evidence, always=True)
+        + encode_message_field(3, validator, always=True)
+        + encode_message_field(4, version, always=True)
+        + encode_message_field(5, synchrony, always=True)
+        + encode_message_field(6, timeout, always=True)
+        + encode_message_field(7, abci, always=True)
+    )
+
+
+def consensus_params_from_proto_bytes(data: bytes) -> "ConsensusParams":
+    from tendermint_tpu.encoding.proto import Reader
+
+    p = ConsensusParams()
+    r = Reader(data)
+    for f, w in r.fields():
+        if w != 2:
+            r.skip(w)
+            continue
+        payload = r.read_bytes()
+        pr = Reader(payload)
+        if f == 1:
+            max_bytes = max_gas = 0
+            for pf, pw in pr.fields():
+                if pf == 1 and pw == 0:
+                    max_bytes = pr.read_svarint()
+                elif pf == 2 and pw == 0:
+                    max_gas = pr.read_svarint()
+                else:
+                    pr.skip(pw)
+            p.block = BlockParams(max_bytes, max_gas)
+        elif f == 2:
+            blocks = 0
+            dur = 0.0
+            mb = 0
+            for pf, pw in pr.fields():
+                if pf == 1 and pw == 0:
+                    blocks = pr.read_svarint()
+                elif pf == 2 and pw == 2:
+                    dur = _decode_duration(pr.read_bytes())
+                elif pf == 3 and pw == 0:
+                    mb = pr.read_svarint()
+                else:
+                    pr.skip(pw)
+            p.evidence = EvidenceParams(blocks, dur, mb)
+        elif f == 3:
+            kts = []
+            for pf, pw in pr.fields():
+                if pf == 1 and pw == 2:
+                    kts.append(pr.read_bytes().decode())
+                else:
+                    pr.skip(pw)
+            p.validator = ValidatorParams(kts)
+        elif f == 4:
+            app_version = 0
+            for pf, pw in pr.fields():
+                if pf == 1 and pw == 0:
+                    app_version = pr.read_varint()
+                else:
+                    pr.skip(pw)
+            p.version = VersionParams(app_version)
+        elif f == 5:
+            precision = message_delay = 0.0
+            for pf, pw in pr.fields():
+                if pf == 1 and pw == 2:
+                    precision = _decode_duration(pr.read_bytes())
+                elif pf == 2 and pw == 2:
+                    message_delay = _decode_duration(pr.read_bytes())
+                else:
+                    pr.skip(pw)
+            p.synchrony = SynchronyParams(precision, message_delay)
+        elif f == 6:
+            vals = {}
+            bypass = False
+            for pf, pw in pr.fields():
+                if pf in (1, 2, 3, 4, 5) and pw == 2:
+                    vals[pf] = _decode_duration(pr.read_bytes())
+                elif pf == 6 and pw == 0:
+                    bypass = bool(pr.read_varint())
+                else:
+                    pr.skip(pw)
+            p.timeout = TimeoutParams(
+                propose=vals.get(1, 0.0),
+                propose_delta=vals.get(2, 0.0),
+                vote=vals.get(3, 0.0),
+                vote_delta=vals.get(4, 0.0),
+                commit=vals.get(5, 0.0),
+                bypass_commit_timeout=bypass,
+            )
+        elif f == 7:
+            h = 0
+            for pf, pw in pr.fields():
+                if pf == 1 and pw == 0:
+                    h = pr.read_svarint()
+                else:
+                    pr.skip(pw)
+            p.abci = ABCIParams(h)
+    return p

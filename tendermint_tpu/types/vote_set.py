@@ -326,3 +326,20 @@ class VoteSet:
     def make_commit(self) -> Commit:
         """Plain commit (pre-extension networks)."""
         return self.make_extended_commit().to_commit()
+
+
+def vote_set_from_commit(
+    chain_id: str, commit: Commit, val_set: ValidatorSet
+) -> VoteSet:
+    """Rebuild the precommit VoteSet a commit came from — the restart path
+    reconstructLastCommit (types/vote_set.go CommitToVoteSet)."""
+    vs = VoteSet(
+        chain_id, commit.height, commit.round, SIGNED_MSG_TYPE_PRECOMMIT, val_set
+    )
+    for idx, cs in enumerate(commit.signatures):
+        if cs.is_absent():
+            continue
+        vote = commit.get_vote(idx)
+        if not vs.add_vote(vote):
+            raise VoteSetError(f"failed to reconstruct commit vote {idx}")
+    return vs

@@ -266,9 +266,12 @@ class TestHeaderAndBlock:
         assert back.last_commit.hash() == last_commit.hash()
         assert back.header.data_hash == block.header.data_hash
 
-    def test_data_hash_is_merkle_of_txs(self):
+    def test_data_hash_is_merkle_of_tx_hashes(self):
+        # Leaves are sha256(tx), not raw tx bytes (types/tx.go Txs.Hash).
         d = Data(txs=[b"a", b"b"])
-        assert d.hash() == merkle.hash_from_byte_slices([b"a", b"b"])
+        assert d.hash() == merkle.hash_from_byte_slices(
+            [hashlib.sha256(b"a").digest(), hashlib.sha256(b"b").digest()]
+        )
 
 
 class TestExtendedCommit:

@@ -1,0 +1,124 @@
+"""FilePV tests (privval/file_test.go analog): persistence, HRS guard,
+timestamp-only re-sign, extension signing."""
+
+import pytest
+
+from tendermint_tpu.encoding.canonical import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+    Timestamp,
+)
+from tendermint_tpu.privval import DoubleSignError, FilePV
+from tendermint_tpu.types import BlockID, Proposal, Vote
+from tests.helpers import CHAIN_ID, make_block_id
+
+TS = Timestamp.from_unix_ns(1_700_000_000_000_000_000)
+TS2 = Timestamp.from_unix_ns(1_700_000_001_000_000_000)
+
+
+@pytest.fixture()
+def pv(tmp_path):
+    return FilePV.generate(str(tmp_path / "key.json"), str(tmp_path / "state.json"))
+
+
+def _vote(pv, type_=SIGNED_MSG_TYPE_PREVOTE, height=1, round_=0, bid=None, ts=TS,
+          extension=b""):
+    return Vote(
+        type=type_,
+        height=height,
+        round=round_,
+        block_id=bid if bid is not None else make_block_id(),
+        timestamp=ts,
+        validator_address=pv.get_pub_key().address(),
+        validator_index=0,
+        extension=extension,
+    )
+
+
+class TestFilePV:
+    def test_sign_and_verify(self, pv):
+        v = _vote(pv)
+        pv.sign_vote(CHAIN_ID, v)
+        v.verify(CHAIN_ID, pv.get_pub_key())
+
+    def test_persistence_roundtrip(self, pv, tmp_path):
+        v = _vote(pv)
+        pv.sign_vote(CHAIN_ID, v)
+        reloaded = FilePV.load(str(tmp_path / "key.json"), str(tmp_path / "state.json"))
+        assert reloaded.get_pub_key() == pv.get_pub_key()
+        assert reloaded.last_sign_state.height == 1
+        assert reloaded.last_sign_state.step == 2
+        assert reloaded.last_sign_state.signature == v.signature
+
+    def test_height_regression_rejected(self, pv):
+        pv.sign_vote(CHAIN_ID, _vote(pv, height=5))
+        with pytest.raises(DoubleSignError, match="height regression"):
+            pv.sign_vote(CHAIN_ID, _vote(pv, height=4))
+
+    def test_round_regression_rejected(self, pv):
+        pv.sign_vote(CHAIN_ID, _vote(pv, height=5, round_=3))
+        with pytest.raises(DoubleSignError, match="round regression"):
+            pv.sign_vote(CHAIN_ID, _vote(pv, height=5, round_=2))
+
+    def test_step_regression_rejected(self, pv):
+        pv.sign_vote(CHAIN_ID, _vote(pv, type_=SIGNED_MSG_TYPE_PRECOMMIT))
+        with pytest.raises(DoubleSignError, match="step regression"):
+            pv.sign_vote(CHAIN_ID, _vote(pv, type_=SIGNED_MSG_TYPE_PREVOTE))
+
+    def test_same_vote_reuses_signature(self, pv):
+        v1 = _vote(pv)
+        pv.sign_vote(CHAIN_ID, v1)
+        v2 = _vote(pv)
+        pv.sign_vote(CHAIN_ID, v2)
+        assert v2.signature == v1.signature
+
+    def test_timestamp_only_diff_reuses_signature(self, pv):
+        v1 = _vote(pv, ts=TS)
+        pv.sign_vote(CHAIN_ID, v1)
+        v2 = _vote(pv, ts=TS2)
+        pv.sign_vote(CHAIN_ID, v2)
+        assert v2.signature == v1.signature
+        assert v2.timestamp == TS  # reverted to the signed timestamp
+        v2.verify(CHAIN_ID, pv.get_pub_key())
+
+    def test_conflicting_block_rejected(self, pv):
+        pv.sign_vote(CHAIN_ID, _vote(pv, bid=make_block_id(b"a")))
+        with pytest.raises(DoubleSignError, match="conflicting data"):
+            pv.sign_vote(CHAIN_ID, _vote(pv, bid=make_block_id(b"b")))
+
+    def test_precommit_extension_signed(self, pv):
+        v = _vote(pv, type_=SIGNED_MSG_TYPE_PRECOMMIT, extension=b"price:9")
+        pv.sign_vote(CHAIN_ID, v)
+        assert v.extension_signature
+        v.verify_vote_and_extension(CHAIN_ID, pv.get_pub_key())
+
+    def test_extension_on_prevote_rejected(self, pv):
+        v = _vote(pv, type_=SIGNED_MSG_TYPE_PREVOTE, extension=b"x")
+        with pytest.raises(ValueError, match="extension"):
+            pv.sign_vote(CHAIN_ID, v)
+
+    def test_nil_precommit_no_extension_signature(self, pv):
+        v = _vote(pv, type_=SIGNED_MSG_TYPE_PRECOMMIT, bid=BlockID())
+        pv.sign_vote(CHAIN_ID, v)
+        assert v.extension_signature == b""
+
+    def test_sign_proposal_and_hrs(self, pv):
+        p = Proposal(
+            height=3, round=0, pol_round=-1, block_id=make_block_id(), timestamp=TS
+        )
+        pv.sign_proposal(CHAIN_ID, p)
+        assert p.signature
+        # proposal step (1) precedes votes at same HRS: prevote allowed after
+        pv.sign_vote(CHAIN_ID, _vote(pv, height=3))
+        with pytest.raises(DoubleSignError):
+            pv.sign_proposal(
+                CHAIN_ID,
+                Proposal(height=3, round=0, pol_round=-1,
+                         block_id=make_block_id(b"other"), timestamp=TS),
+            )
+
+    def test_load_or_generate(self, tmp_path):
+        key, state = str(tmp_path / "k.json"), str(tmp_path / "s.json")
+        pv1 = FilePV.load_or_generate(key, state)
+        pv2 = FilePV.load_or_generate(key, state)
+        assert pv1.get_pub_key() == pv2.get_pub_key()

@@ -1,0 +1,195 @@
+"""Storage tests: KV, BlockStore, StateStore (internal/store, internal/state)."""
+
+import pytest
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.encoding.canonical import Timestamp
+from tendermint_tpu.state import State, StateStore, state_from_genesis
+from tendermint_tpu.storage import MemDB
+from tendermint_tpu.storage.blockstore import BlockStore
+from tendermint_tpu.types import BlockID, Consensus, make_block
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.part_set import PartSet
+from tests.helpers import CHAIN_ID, make_block_id, make_commit, make_validators
+
+
+class TestMemDB:
+    def test_ordering_and_range(self):
+        db = MemDB()
+        for k in [b"b", b"a", b"c", b"aa"]:
+            db.set(k, k.upper())
+        assert [k for k, _ in db.iterator()] == [b"a", b"aa", b"b", b"c"]
+        assert [k for k, _ in db.iterator(b"aa", b"c")] == [b"aa", b"b"]
+        assert [k for k, _ in db.reverse_iterator()] == [b"c", b"b", b"aa", b"a"]
+        db.delete(b"aa")
+        assert db.get(b"aa") is None
+        assert [k for k, _ in db.iterator()] == [b"a", b"b", b"c"]
+
+    def test_batch_atomicity(self):
+        db = MemDB()
+        b = db.new_batch()
+        b.set(b"x", b"1").set(b"y", b"2").delete(b"x")
+        assert db.get(b"x") is None and db.get(b"y") is None
+        b.write()
+        assert db.get(b"x") is None and db.get(b"y") == b"2"
+
+
+def _make_saved_chain(n_heights=3, n_vals=3):
+    privs, vset = make_validators(n_vals)
+    store = BlockStore(MemDB())
+    blocks = []
+    prev_commit = None
+    prev_bid = make_block_id(b"genesis")
+    for h in range(1, n_heights + 1):
+        last_commit = prev_commit or make_commit(prev_bid, 0, 0, vset, privs)
+        if h == 1:
+            from tendermint_tpu.types import Commit
+
+            last_commit = Commit()
+        block = make_block(h, [b"tx-%d" % h], last_commit)
+        block.header.version = Consensus(block=11)
+        block.header.chain_id = CHAIN_ID
+        block.header.time = Timestamp.from_unix_ns(1_700_000_000_000_000_000 + h)
+        block.header.validators_hash = vset.hash()
+        block.header.next_validators_hash = vset.hash()
+        block.header.proposer_address = vset.validators[0].address
+        block.header.last_block_id = prev_bid
+        parts = PartSet.from_data(block.to_proto_bytes(), part_size=1024)
+        bid = BlockID(block.hash(), parts.header())
+        seen = make_commit(bid, h, 0, vset, privs)
+        store.save_block(block, parts, seen)
+        blocks.append((block, bid, seen))
+        prev_bid = bid
+        prev_commit = seen
+    return store, blocks, vset, privs
+
+
+class TestBlockStore:
+    def test_save_load_roundtrip(self):
+        store, blocks, _, _ = _make_saved_chain()
+        assert store.base() == 1 and store.height() == 3 and store.size() == 3
+        for h, (block, bid, seen) in enumerate(blocks, start=1):
+            meta = store.load_block_meta(h)
+            assert meta is not None and meta.block_id == bid
+            loaded = store.load_block(h)
+            assert loaded.hash() == block.hash()
+            assert loaded.data.txs == block.data.txs
+        # canonical commit for h is stored when block h+1 is saved
+        c2 = store.load_block_commit(2)
+        assert c2 is not None and c2.height == 2
+        seen = store.load_seen_commit()
+        assert seen is not None and seen.height == 3
+
+    def test_load_by_hash(self):
+        store, blocks, _, _ = _make_saved_chain()
+        block, bid, _ = blocks[1]
+        assert store.load_block_by_hash(block.hash()).hash() == block.hash()
+        assert store.load_block_by_hash(b"\x00" * 32) is None
+
+    def test_contiguity_enforced(self):
+        store, blocks, vset, privs = _make_saved_chain(2)
+        block = make_block(7, [], make_commit(make_block_id(), 6, 0, vset, privs))
+        block.header.validators_hash = vset.hash()
+        parts = PartSet.from_data(block.to_proto_bytes(), part_size=1024)
+        with pytest.raises(ValueError, match="contiguous"):
+            store.save_block(block, parts, make_commit(make_block_id(), 7, 0, vset, privs))
+
+    def test_prune(self):
+        store, blocks, _, _ = _make_saved_chain(3)
+        assert store.prune_blocks(3) == 2
+        assert store.base() == 3
+        assert store.load_block(1) is None
+        assert store.load_block(3) is not None
+
+    def test_reopen_recovers_height(self):
+        store, _, _, _ = _make_saved_chain(3)
+        reopened = BlockStore(store._db)
+        assert reopened.base() == 1 and reopened.height() == 3
+
+
+def _genesis_state(n_vals=3):
+    privs, vset = make_validators(n_vals)
+    gen = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=Timestamp.from_unix_ns(1_700_000_000_000_000_000),
+        validators=[
+            GenesisValidator(pub_key=v.pub_key, power=v.voting_power)
+            for v in vset.validators
+        ],
+    )
+    return privs, state_from_genesis(gen)
+
+
+class TestStateStore:
+    def test_save_load_roundtrip(self):
+        privs, state = _genesis_state()
+        store = StateStore(MemDB())
+        store.save(state)
+        loaded = store.load()
+        assert loaded.chain_id == state.chain_id
+        assert loaded.last_block_height == 0
+        assert loaded.validators.hash() == state.validators.hash()
+        assert loaded.next_validators.hash() == state.next_validators.hash()
+        assert loaded.consensus_params == state.consensus_params
+        assert loaded.initial_height == 1
+
+    def test_load_validators_at_heights(self):
+        privs, state = _genesis_state()
+        store = StateStore(MemDB())
+        store.save(state)
+        v1 = store.load_validators(1)
+        assert v1.hash() == state.validators.hash()
+        v2 = store.load_validators(2)
+        assert v2.hash() == state.next_validators.hash()
+        # proposer priorities replayed identically
+        assert [v.proposer_priority for v in v2.validators] == [
+            v.proposer_priority for v in state.next_validators.validators
+        ]
+
+    def test_genesis_state_structure(self):
+        privs, state = _genesis_state()
+        assert state.last_validators.is_nil_or_empty()
+        assert len(state.validators) == 3
+        # next validators are rotated one step ahead
+        assert state.next_validators.hash() == state.validators.hash()
+
+    def test_finalize_responses(self):
+        _, state = _genesis_state()
+        store = StateStore(MemDB())
+        store.save_finalize_block_response(5, b"resp5")
+        assert store.load_finalize_block_response(5) == b"resp5"
+        assert store.load_finalize_block_response(6) is None
+
+
+class TestGenesisDoc:
+    def test_json_roundtrip(self, tmp_path):
+        privs, vset = make_validators(2)
+        gen = GenesisDoc(
+            chain_id=CHAIN_ID,
+            genesis_time=Timestamp.from_unix_ns(1_700_000_000_123_456_789),
+            validators=[
+                GenesisValidator(pub_key=v.pub_key, power=v.voting_power)
+                for v in vset.validators
+            ],
+            app_state=b'{"accounts": 3}',
+        )
+        gen.validate_and_complete()
+        path = str(tmp_path / "genesis.json")
+        gen.save_as(path)
+        back = GenesisDoc.from_file(path)
+        assert back.chain_id == gen.chain_id
+        assert back.genesis_time == gen.genesis_time
+        assert back.initial_height == 1
+        assert [v.pub_key for v in back.validators] == [
+            v.pub_key for v in gen.validators
+        ]
+        assert back.validator_set().hash() == vset.hash()
+
+    def test_rejects_zero_power(self):
+        privs, vset = make_validators(1)
+        gen = GenesisDoc(
+            chain_id=CHAIN_ID,
+            validators=[GenesisValidator(pub_key=vset.validators[0].pub_key, power=0)],
+        )
+        with pytest.raises(ValueError, match="voting power"):
+            gen.validate_and_complete()
