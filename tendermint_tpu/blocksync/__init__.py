@@ -1,0 +1,8 @@
+"""Block sync: catch up by fetching verified blocks from peers
+(reference: internal/blocksync/), with ranges of commits verified in one
+device batch (parallel/pipeline.py)."""
+
+from tendermint_tpu.blocksync.pool import BlockPool, PeerInfo
+from tendermint_tpu.blocksync.syncer import BlockSyncer
+
+__all__ = ["BlockPool", "BlockSyncer", "PeerInfo"]

@@ -1,0 +1,9 @@
+"""Evidence pool and verification (reference: internal/evidence/)."""
+
+from tendermint_tpu.evidence.pool import EvidencePool
+from tendermint_tpu.evidence.verify import (
+    verify_duplicate_vote,
+    verify_light_client_attack,
+)
+
+__all__ = ["EvidencePool", "verify_duplicate_vote", "verify_light_client_attack"]

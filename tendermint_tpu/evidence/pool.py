@@ -1,0 +1,222 @@
+"""EvidencePool: persist, verify, and expire byzantine-behavior evidence.
+
+Mirrors internal/evidence/pool.go:42-411: pending evidence is KV-persisted
+(survives restarts), pruned when expired by the consensus params' age
+limits, fed to block proposals, marked committed after blocks land, and
+populated from consensus's conflicting-vote reports.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from tendermint_tpu.encoding.canonical import Timestamp
+from tendermint_tpu.evidence.verify import (
+    InvalidEvidenceError,
+    verify_duplicate_vote,
+    verify_light_client_attack,
+)
+from tendermint_tpu.state.state import State
+from tendermint_tpu.storage.blockstore import BlockStore
+from tendermint_tpu.storage.kv import KVStore, MemDB, ordered_key, prefix_end
+from tendermint_tpu.types.block import Vote
+from tendermint_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    Evidence,
+    LightClientAttackEvidence,
+    evidence_from_proto_bytes,
+)
+from tendermint_tpu.types.light import SignedHeader
+
+PREFIX_PENDING = 9
+PREFIX_COMMITTED = 10
+
+
+def _pending_key(ev: Evidence) -> bytes:
+    return ordered_key(PREFIX_PENDING, ev.height()) + ev.hash()
+
+
+def _committed_key(ev: Evidence) -> bytes:
+    return ordered_key(PREFIX_COMMITTED, ev.height()) + ev.hash()
+
+
+class EvidencePool:
+    def __init__(
+        self,
+        db: Optional[KVStore] = None,
+        state_store=None,
+        block_store: Optional[BlockStore] = None,
+    ):
+        self._db = db or MemDB()
+        self.state_store = state_store
+        self.block_store = block_store
+        self._mtx = threading.Lock()
+        self.state: Optional[State] = None
+
+    def set_state(self, state: State) -> None:
+        self.state = state
+
+    # --- queries -------------------------------------------------------------
+
+    def pending_evidence(self, max_bytes: int) -> Tuple[List[Evidence], int]:
+        """pool.go PendingEvidence: size-capped, height order."""
+        out: List[Evidence] = []
+        total = 0
+        for _, v in self._db.iterator(
+            ordered_key(PREFIX_PENDING, 0), prefix_end(bytes([PREFIX_PENDING]))
+        ):
+            ev = evidence_from_proto_bytes(v)
+            size = len(v)
+            if max_bytes >= 0 and total + size > max_bytes:
+                break
+            out.append(ev)
+            total += size
+        return out, total
+
+    def is_pending(self, ev: Evidence) -> bool:
+        return self._db.has(_pending_key(ev))
+
+    def is_committed(self, ev: Evidence) -> bool:
+        return self._db.has(_committed_key(ev))
+
+    # --- ingestion -----------------------------------------------------------
+
+    def add_evidence(self, ev: Evidence) -> None:
+        """pool.go AddEvidence: dedupe, verify, persist."""
+        with self._mtx:
+            if self.is_pending(ev) or self.is_committed(ev):
+                return
+            self.verify(ev)
+            self._db.set(_pending_key(ev), ev.to_proto_bytes())
+
+    def report_conflicting_votes(self, vote_a: Vote, vote_b: Vote) -> None:
+        """pool.go ReportConflictingVotes (via consensus): build duplicate
+        vote evidence from the current state."""
+        if self.state is None:
+            return
+        try:
+            ev = DuplicateVoteEvidence.new(
+                vote_a,
+                vote_b,
+                self.state.last_block_time,
+                self.state.validators,
+            )
+            self.add_evidence(ev)
+        except (ValueError, InvalidEvidenceError):
+            pass
+
+    # --- verification --------------------------------------------------------
+
+    def verify(self, ev: Evidence) -> None:
+        """pool.go verify (abridged): age window + type-specific checks."""
+        if self.state is None:
+            raise InvalidEvidenceError("evidence pool has no state")
+        state = self.state
+        ev_params = state.consensus_params.evidence
+        # Age by duration is measured against OUR header time at the
+        # evidence height (verify.go:39-60) — the evidence's own timestamp
+        # field is attacker-controlled and must not gate expiry.
+        ev_time = ev.time()
+        if self.block_store is not None:
+            meta = self.block_store.load_block_meta(ev.height())
+            if meta is None:
+                raise InvalidEvidenceError(
+                    f"don't have block meta at height {ev.height()}"
+                )
+            ev_time = meta.header.time
+        age_blocks = state.last_block_height - ev.height()
+        age_ns = state.last_block_time.to_unix_ns() - ev_time.to_unix_ns()
+        if (
+            age_blocks > ev_params.max_age_num_blocks
+            and age_ns > ev_params.max_age_duration * 1e9
+        ):
+            raise InvalidEvidenceError(
+                f"evidence from height {ev.height()} is too old"
+            )
+        if isinstance(ev, DuplicateVoteEvidence):
+            val_set = self._validators_at(ev.height())
+            verify_duplicate_vote(ev, state.chain_id, val_set)
+            # ABCI fields must match our records (verify.go:120-135).
+            _, val = val_set.get_by_address(ev.vote_a.validator_address)
+            if ev.validator_power != val.voting_power:
+                raise InvalidEvidenceError("validator power mismatch")
+            if ev.total_voting_power != val_set.total_voting_power():
+                raise InvalidEvidenceError("total voting power mismatch")
+        elif isinstance(ev, LightClientAttackEvidence):
+            common = self._signed_header_at(ev.common_height)
+            trusted = self._signed_header_at(ev.conflicting_block.height)
+            if common is None or trusted is None:
+                raise InvalidEvidenceError(
+                    "don't have headers to verify the light client attack"
+                )
+            common_vals = self._validators_at(ev.common_height)
+            verify_light_client_attack(ev, common, trusted, common_vals)
+            # ABCI fields must match our records (verify.go:135-141 /
+            # ValidateABCI) — same policy as the duplicate-vote branch.
+            if ev.total_voting_power != common_vals.total_voting_power():
+                raise InvalidEvidenceError(
+                    "total voting power from the evidence and our validator "
+                    "set does not match"
+                )
+            if ev.timestamp != common.header.time:
+                raise InvalidEvidenceError(
+                    "evidence has a different time to the block it is "
+                    "associated with"
+                )
+        else:
+            raise InvalidEvidenceError(f"unknown evidence type {type(ev)}")
+
+    def _validators_at(self, height: int):
+        if self.state_store is None:
+            raise InvalidEvidenceError("no state store to load validators")
+        return self.state_store.load_validators(height)
+
+    def _signed_header_at(self, height: int) -> Optional[SignedHeader]:
+        if self.block_store is None:
+            return None
+        meta = self.block_store.load_block_meta(height)
+        commit = self.block_store.load_block_commit(height)
+        if meta is None or commit is None:
+            return None
+        return SignedHeader(header=meta.header, commit=commit)
+
+    # --- consensus hooks -----------------------------------------------------
+
+    def check_evidence(self, evidence: List[Evidence]) -> None:
+        """pool.go CheckEvidence: verify block evidence, dedupe committed."""
+        seen = set()
+        for ev in evidence:
+            key = ev.hash()
+            if key in seen:
+                raise InvalidEvidenceError("duplicate evidence in block")
+            seen.add(key)
+            if self.is_committed(ev):
+                raise InvalidEvidenceError("evidence was already committed")
+            if not self.is_pending(ev):
+                self.verify(ev)
+
+    def update(self, state: State, block_evidence: List[Evidence]) -> None:
+        """pool.go Update: mark committed, prune expired."""
+        self.state = state
+        with self._mtx:
+            for ev in block_evidence:
+                self._db.set(_committed_key(ev), b"\x01")
+                self._db.delete(_pending_key(ev))
+            self._prune_expired(state)
+
+    def _prune_expired(self, state: State) -> None:
+        ev_params = state.consensus_params.evidence
+        batch = self._db.new_batch()
+        for k, v in self._db.iterator(
+            ordered_key(PREFIX_PENDING, 0), prefix_end(bytes([PREFIX_PENDING]))
+        ):
+            ev = evidence_from_proto_bytes(v)
+            age_blocks = state.last_block_height - ev.height()
+            age_ns = state.last_block_time.to_unix_ns() - ev.time().to_unix_ns()
+            if (
+                age_blocks > ev_params.max_age_num_blocks
+                and age_ns > ev_params.max_age_duration * 1e9
+            ):
+                batch.delete(k)
+        batch.write()

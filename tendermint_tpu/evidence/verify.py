@@ -1,0 +1,109 @@
+"""Evidence verification (internal/evidence/verify.go).
+
+Both checks end in signature verification against historical validator
+sets — the third call site of the batch crypto boundary (SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.light.verifier import DEFAULT_TRUST_LEVEL
+from tendermint_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    LightClientAttackEvidence,
+)
+from tendermint_tpu.types.light import SignedHeader
+from tendermint_tpu.types.validation import (
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+
+class InvalidEvidenceError(ValueError):
+    pass
+
+
+def verify_duplicate_vote(
+    e: DuplicateVoteEvidence, chain_id: str, val_set: ValidatorSet
+) -> None:
+    """internal/evidence/verify.go:203-256."""
+    _, val = val_set.get_by_address(e.vote_a.validator_address)
+    if val is None:
+        raise InvalidEvidenceError(
+            f"address {e.vote_a.validator_address.hex()} was not a validator "
+            f"at height {e.height()}"
+        )
+    pub_key = val.pub_key
+    if (
+        e.vote_a.height != e.vote_b.height
+        or e.vote_a.round != e.vote_b.round
+        or e.vote_a.type != e.vote_b.type
+    ):
+        raise InvalidEvidenceError("h/r/s does not match")
+    if e.vote_a.validator_address != e.vote_b.validator_address:
+        raise InvalidEvidenceError("validator addresses do not match")
+    if e.vote_a.block_id == e.vote_b.block_id:
+        raise InvalidEvidenceError(
+            "block IDs are the same - not a real duplicate vote"
+        )
+    if pub_key.address() != e.vote_a.validator_address:
+        raise InvalidEvidenceError("address doesn't match pubkey")
+    if not pub_key.verify_signature(
+        e.vote_a.sign_bytes(chain_id), e.vote_a.signature
+    ):
+        raise InvalidEvidenceError("verifying VoteA: invalid signature")
+    if not pub_key.verify_signature(
+        e.vote_b.sign_bytes(chain_id), e.vote_b.signature
+    ):
+        raise InvalidEvidenceError("verifying VoteB: invalid signature")
+
+
+def verify_light_client_attack(
+    e: LightClientAttackEvidence,
+    common_header: SignedHeader,
+    trusted_header: SignedHeader,
+    common_vals: ValidatorSet,
+) -> None:
+    """internal/evidence/verify.go:160-196."""
+    if common_header.height != e.conflicting_block.height:
+        # Lunatic attack: single trusting jump from the common header.
+        try:
+            verify_commit_light_trusting(
+                trusted_header.chain_id,
+                common_vals,
+                e.conflicting_block.signed_header.commit,
+                DEFAULT_TRUST_LEVEL,
+            )
+        except ValueError as err:
+            raise InvalidEvidenceError(
+                f"skipping verification of conflicting block failed: {err}"
+            ) from err
+    elif e.conflicting_header_is_invalid(trusted_header.header):
+        raise InvalidEvidenceError(
+            "common height is the same as conflicting block height so expected "
+            "the conflicting block to be correctly derived yet it wasn't"
+        )
+    try:
+        verify_commit_light(
+            trusted_header.chain_id,
+            e.conflicting_block.validator_set,
+            e.conflicting_block.signed_header.commit.block_id,
+            e.conflicting_block.height,
+            e.conflicting_block.signed_header.commit,
+        )
+    except ValueError as err:
+        raise InvalidEvidenceError(
+            f"invalid commit from conflicting block: {err}"
+        ) from err
+    if e.conflicting_block.height > trusted_header.height:
+        if (
+            e.conflicting_block.signed_header.header.time.to_unix_ns()
+            > trusted_header.header.time.to_unix_ns()
+        ):
+            raise InvalidEvidenceError(
+                "conflicting block doesn't violate monotonically increasing time"
+            )
+    elif trusted_header.hash() == e.conflicting_block.hash():
+        raise InvalidEvidenceError(
+            "trusted header hash matches the evidence's conflicting header hash"
+        )

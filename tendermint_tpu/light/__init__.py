@@ -1,0 +1,36 @@
+"""Light client (reference: light/): stateless verification, bisection
+client with trusted store, and the fork/attack detector."""
+
+from tendermint_tpu.light.verifier import (
+    DEFAULT_TRUST_LEVEL,
+    HeaderExpiredError,
+    InvalidHeaderError,
+    NewValSetCantBeTrustedError,
+    header_expired,
+    validate_trust_level,
+    verify,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)
+from tendermint_tpu.light.client import LightClient, TrustOptions
+from tendermint_tpu.light.provider import Provider, MemoryProvider
+from tendermint_tpu.light.store import LightStore
+
+__all__ = [
+    "DEFAULT_TRUST_LEVEL",
+    "HeaderExpiredError",
+    "InvalidHeaderError",
+    "LightClient",
+    "LightStore",
+    "MemoryProvider",
+    "NewValSetCantBeTrustedError",
+    "Provider",
+    "TrustOptions",
+    "header_expired",
+    "validate_trust_level",
+    "verify",
+    "verify_adjacent",
+    "verify_backwards",
+    "verify_non_adjacent",
+]

@@ -1,0 +1,77 @@
+"""Light-block providers (light/provider/provider.go).
+
+A provider serves LightBlocks by height and accepts evidence of
+misbehavior. MemoryProvider is the in-process test double (the mock/http
+split of the reference); an RPC-backed provider plugs in the same ABC.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from tendermint_tpu.types.evidence import Evidence
+from tendermint_tpu.types.light import LightBlock
+
+
+class ProviderError(Exception):
+    pass
+
+
+class LightBlockNotFoundError(ProviderError):
+    """provider.ErrLightBlockNotFound."""
+
+
+class HeightTooHighError(ProviderError):
+    """provider.ErrHeightTooHigh: the provider chain is shorter."""
+
+
+class Provider:
+    def chain_id(self) -> str:
+        raise NotImplementedError
+
+    def light_block(self, height: int) -> LightBlock:
+        """Returns the LightBlock at height (0 = latest); raises
+        LightBlockNotFoundError / HeightTooHighError."""
+        raise NotImplementedError
+
+    def report_evidence(self, evidence: Evidence) -> None:
+        raise NotImplementedError
+
+
+class MemoryProvider(Provider):
+    def __init__(self, chain_id: str, blocks: Optional[List[LightBlock]] = None):
+        self._chain_id = chain_id
+        self._blocks: Dict[int, LightBlock] = {}
+        self.evidence: List[Evidence] = []
+        self._lock = threading.Lock()
+        for lb in blocks or []:
+            self._blocks[lb.height] = lb
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def add(self, lb: LightBlock) -> None:
+        with self._lock:
+            self._blocks[lb.height] = lb
+
+    def latest_height(self) -> int:
+        with self._lock:
+            return max(self._blocks) if self._blocks else 0
+
+    def light_block(self, height: int) -> LightBlock:
+        with self._lock:
+            if not self._blocks:
+                raise LightBlockNotFoundError(f"no blocks (chain {self._chain_id})")
+            latest = max(self._blocks)
+            if height == 0:
+                return self._blocks[latest]
+            if height > latest:
+                raise HeightTooHighError(f"height {height} > latest {latest}")
+            if height not in self._blocks:
+                raise LightBlockNotFoundError(f"no light block at height {height}")
+            return self._blocks[height]
+
+    def report_evidence(self, evidence: Evidence) -> None:
+        with self._lock:
+            self.evidence.append(evidence)

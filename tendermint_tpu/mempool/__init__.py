@@ -1,0 +1,6 @@
+"""Priority mempool (reference: internal/mempool/)."""
+
+from tendermint_tpu.mempool.mempool import MempoolConfig, TxMempool
+from tendermint_tpu.mempool.cache import LRUTxCache
+
+__all__ = ["LRUTxCache", "MempoolConfig", "TxMempool"]

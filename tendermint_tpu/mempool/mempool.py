@@ -1,0 +1,307 @@
+"""TxMempool: priority mempool gated by ABCI CheckTx.
+
+Mirrors internal/mempool/mempool.go:36-770: admission via CheckTx with
+an LRU seen-cache, priority ordering (priority desc, then arrival order),
+size/gas-bounded reaping, post-commit Update with recheck of survivors,
+TTL expiry, and eviction of lower-priority txs when full.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from dataclasses import dataclass, field as dc_field
+from typing import Callable, Dict, List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import AbciClient
+from tendermint_tpu.mempool.cache import LRUTxCache, NopTxCache
+from tendermint_tpu.types.block import tx_hash
+
+
+@dataclass
+class MempoolConfig:
+    """config/config.go MempoolConfig subset."""
+
+    size: int = 5000
+    max_txs_bytes: int = 1024 * 1024 * 1024
+    cache_size: int = 10000
+    max_tx_bytes: int = 1024 * 1024
+    ttl_duration: float = 0.0  # seconds; 0 = no TTL
+    ttl_num_blocks: int = 0
+    recheck: bool = True
+    keep_invalid_txs_in_cache: bool = False
+
+
+@dataclass
+class WrappedTx:
+    """internal/mempool/tx.go WrappedTx."""
+
+    tx: bytes
+    hash: bytes
+    height: int
+    timestamp: float
+    gas_wanted: int = 0
+    priority: int = 0
+    sender: str = ""
+    seq: int = 0  # arrival order tiebreak
+
+    def size(self) -> int:
+        return len(self.tx)
+
+
+class TxMempool:
+    def __init__(
+        self,
+        config: MempoolConfig,
+        app_client: AbciClient,
+        height: int = 0,
+        now: Optional[Callable[[], float]] = None,
+    ):
+        self.config = config
+        self.app = app_client
+        self.height = height
+        self._now = now or _time.monotonic
+        self._mtx = threading.RLock()
+        self.cache = (
+            LRUTxCache(config.cache_size) if config.cache_size > 0 else NopTxCache()
+        )
+        self._by_key: Dict[bytes, WrappedTx] = {}
+        self._by_sender: Dict[str, WrappedTx] = {}
+        self._txs_bytes = 0
+        self._seq = 0
+        self._txs_available_event = threading.Event()
+        self._notify_available = False
+        self.pre_check: Optional[Callable[[bytes], None]] = None
+        self.post_check: Optional[Callable[[bytes, abci.ResponseCheckTx], None]] = None
+
+    # --- locking (used by BlockExecutor.Commit) -----------------------------
+
+    def lock(self) -> None:
+        self._mtx.acquire()
+
+    def unlock(self) -> None:
+        self._mtx.release()
+
+    # --- size ----------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._mtx:
+            return len(self._by_key)
+
+    def size(self) -> int:
+        return len(self)
+
+    def size_bytes(self) -> int:
+        with self._mtx:
+            return self._txs_bytes
+
+    def enable_txs_available(self) -> None:
+        self._notify_available = True
+
+    def txs_available(self) -> threading.Event:
+        return self._txs_available_event
+
+    # --- admission ------------------------------------------------------------
+
+    def check_tx(self, tx: bytes, sender: str = "") -> abci.ResponseCheckTx:
+        """mempool.go:175-243: validate, dedupe, ABCI CheckTx, insert."""
+        if len(tx) > self.config.max_tx_bytes:
+            raise ValueError(
+                f"tx size {len(tx)} exceeds max {self.config.max_tx_bytes}"
+            )
+        if self.pre_check is not None:
+            self.pre_check(tx)
+        key = tx_hash(tx)
+        if not self.cache.push(key):
+            raise KeyError(f"tx already exists in cache: {key.hex()}")
+        with self._mtx:
+            if key in self._by_key:
+                raise KeyError(f"tx already in mempool: {key.hex()}")
+        res = self.app.check_tx(abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW))
+        if self.post_check is not None:
+            self.post_check(tx, res)
+        if not res.is_ok():
+            if not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(key)
+            return res
+        with self._mtx:
+            self._add_new_transaction(tx, key, res, sender)
+        return res
+
+    def _add_new_transaction(
+        self, tx: bytes, key: bytes, res: abci.ResponseCheckTx, sender: str
+    ) -> None:
+        """mempool.go:450-599: sender dedupe, eviction by priority, insert."""
+        sender = res.sender or sender
+        if sender and sender in self._by_sender:
+            raise KeyError(f"tx from same sender already in mempool: {sender}")
+        self._seq += 1
+        wtx = WrappedTx(
+            tx=tx,
+            hash=key,
+            height=self.height,
+            timestamp=self._now(),
+            gas_wanted=res.gas_wanted,
+            priority=res.priority,
+            sender=sender,
+            seq=self._seq,
+        )
+        if not self._can_add(wtx):
+            # Evict enough lower-priority txs to fit, else reject.
+            victims = sorted(
+                (w for w in self._by_key.values() if w.priority < wtx.priority),
+                key=lambda w: (w.priority, -w.timestamp),
+            )
+            available = (
+                self.config.size - len(self._by_key),
+                self.config.max_txs_bytes - self._txs_bytes,
+            )
+            freed_count, freed_bytes = available
+            to_evict = []
+            for v in victims:
+                if freed_count >= 1 and freed_bytes >= wtx.size():
+                    break
+                to_evict.append(v)
+                freed_count += 1
+                freed_bytes += v.size()
+            if freed_count < 1 or freed_bytes < wtx.size():
+                self.cache.remove(key)
+                raise OverflowError("mempool is full")
+            for v in to_evict:
+                self._remove(v.hash)
+                self.cache.remove(v.hash)
+        self._by_key[key] = wtx
+        if sender:
+            self._by_sender[sender] = wtx
+        self._txs_bytes += wtx.size()
+        if self._notify_available and len(self._by_key) == 1:
+            self._txs_available_event.set()
+
+    def _can_add(self, wtx: WrappedTx) -> bool:
+        """mempool.go:714-733."""
+        return (
+            len(self._by_key) < self.config.size
+            and wtx.size() + self._txs_bytes <= self.config.max_txs_bytes
+        )
+
+    # --- removal --------------------------------------------------------------
+
+    def remove_tx_by_key(self, key: bytes) -> None:
+        with self._mtx:
+            self._remove(key)
+            self.cache.remove(key)
+
+    def _remove(self, key: bytes) -> None:
+        wtx = self._by_key.pop(key, None)
+        if wtx is None:
+            return
+        if wtx.sender:
+            self._by_sender.pop(wtx.sender, None)
+        self._txs_bytes -= wtx.size()
+
+    def flush(self) -> None:
+        """Remove all txs; cache stays (mempool.go:280-296)."""
+        with self._mtx:
+            self._by_key.clear()
+            self._by_sender.clear()
+            self._txs_bytes = 0
+
+    # --- reaping --------------------------------------------------------------
+
+    def _sorted_entries(self) -> List[WrappedTx]:
+        """Priority desc, then arrival order (mempool.go:298-323)."""
+        return sorted(self._by_key.values(), key=lambda w: (-w.priority, w.seq))
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        """mempool.go:325-341: stops at the FIRST tx that busts either
+        budget — strict priority order is preserved; lower-priority txs
+        never leapfrog an over-budget high-priority one."""
+        with self._mtx:
+            out: List[bytes] = []
+            total_bytes = total_gas = 0
+            for wtx in self._sorted_entries():
+                total_gas += wtx.gas_wanted
+                total_bytes += wtx.size()
+                if (max_gas >= 0 and total_gas > max_gas) or (
+                    max_bytes >= 0 and total_bytes > max_bytes
+                ):
+                    break
+                out.append(wtx.tx)
+            return out
+
+    def reap_max_txs(self, max_txs: int) -> List[bytes]:
+        with self._mtx:
+            entries = self._sorted_entries()
+            if max_txs >= 0:
+                entries = entries[:max_txs]
+            return [w.tx for w in entries]
+
+    def tx_list(self) -> List[bytes]:
+        """Current txs in gossip order (the clist walk analog)."""
+        with self._mtx:
+            return [w.tx for w in sorted(self._by_key.values(), key=lambda w: w.seq)]
+
+    # --- post-commit update ---------------------------------------------------
+
+    def update(
+        self,
+        height: int,
+        txs: List[bytes],
+        tx_results: List[abci.ExecTxResult],
+        recheck: Optional[bool] = None,
+    ) -> None:
+        """mempool.go:381-448. CONTRACT: caller holds lock() (the executor's
+        Commit does)."""
+        self.height = height
+        self._notify_available and self._txs_available_event.clear()
+        for tx, res in zip(txs, tx_results):
+            key = tx_hash(tx)
+            if res.is_ok():
+                self.cache.push(key)  # committed: keep in cache to dedupe
+            else:
+                self.cache.remove(key)
+            self._remove(key)
+        self._purge_expired(height)
+        do_recheck = self.config.recheck if recheck is None else recheck
+        if do_recheck and self._by_key:
+            self._recheck_transactions()
+        if self._notify_available and self._by_key:
+            self._txs_available_event.set()
+
+    def _purge_expired(self, block_height: int) -> None:
+        """mempool.go:735-759: TTL by age and by blocks."""
+        now = self._now()
+        expired = []
+        for key, wtx in self._by_key.items():
+            if (
+                self.config.ttl_duration > 0
+                and now - wtx.timestamp > self.config.ttl_duration
+            ):
+                expired.append(key)
+            elif (
+                self.config.ttl_num_blocks > 0
+                and block_height - wtx.height > self.config.ttl_num_blocks
+            ):
+                expired.append(key)
+        for key in expired:
+            self._remove(key)
+            self.cache.remove(key)
+
+    def _recheck_transactions(self) -> None:
+        """mempool.go:662-712: re-run CheckTx on survivors after a block."""
+        for wtx in list(self._sorted_entries()):
+            res = self.app.check_tx(
+                abci.RequestCheckTx(tx=wtx.tx, type=abci.CHECK_TX_TYPE_RECHECK)
+            )
+            if self.post_check is not None:
+                try:
+                    self.post_check(wtx.tx, res)
+                except Exception:
+                    res = abci.ResponseCheckTx(code=1)
+            if res.is_ok():
+                wtx.priority = res.priority
+            else:
+                self._remove(wtx.hash)
+                if not self.config.keep_invalid_txs_in_cache:
+                    self.cache.remove(wtx.hash)

@@ -1,0 +1,132 @@
+"""Multi-commit pipelined batch verification.
+
+The reference's blocksync loop verifies one commit per block serially
+(internal/blocksync/reactor.go:538-650, VerifyCommitLight at :582). Here
+whole RANGES of commits are flattened into one device batch: every
+signature from every block in the window rides a single Straus-kernel
+launch (optionally sharded over a mesh), and per-block verdicts are
+sliced back out. This is the pipeline-parallel analog from SURVEY.md
+§2.4 — fetch, device-batch, apply.
+
+Semantics per block match verify_commit_light exactly: ignore non-commit
+sigs, stop adding once tallied power exceeds 2/3, all included sigs must
+verify, tally must exceed 2/3.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from tendermint_tpu.types.block import BLOCK_ID_FLAG_COMMIT, BlockID, Commit
+from tendermint_tpu.types.validation import (
+    InvalidCommitError,
+    NotEnoughVotingPowerError,
+    _verify_basic_vals_and_commit,
+)
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+
+@dataclass
+class CommitTask:
+    """One block's commit to verify: (chain_id, vals, block_id, height, commit)."""
+
+    chain_id: str
+    vals: ValidatorSet
+    block_id: BlockID
+    height: int
+    commit: Commit
+
+
+@dataclass
+class CommitVerdict:
+    ok: bool
+    error: Optional[Exception] = None
+
+
+def verify_commits_pipelined(
+    tasks: Sequence[CommitTask],
+    mesh=None,
+    use_device: Optional[bool] = None,
+) -> List[CommitVerdict]:
+    """Batch-verify many commits in one device launch.
+
+    Returns one verdict per task; a failed batch attributes the first bad
+    signature per block (validation.go:244-251 semantics, per block).
+    """
+    verdicts: List[Optional[CommitVerdict]] = [None] * len(tasks)
+    flat_pks: List[bytes] = []
+    flat_msgs: List[bytes] = []
+    flat_sigs: List[bytes] = []
+    # per-task: (start, [sig_idx...], tallied, needed)
+    spans: List[Optional[Tuple[int, List[int], int, int]]] = [None] * len(tasks)
+
+    for t_i, task in enumerate(tasks):
+        try:
+            _verify_basic_vals_and_commit(
+                task.vals, task.commit, task.height, task.block_id
+            )
+        except InvalidCommitError as e:
+            verdicts[t_i] = CommitVerdict(False, e)
+            continue
+        needed = task.vals.total_voting_power() * 2 // 3
+        start = len(flat_pks)
+        sig_idxs: List[int] = []
+        tallied = 0
+        for idx, cs in enumerate(task.commit.signatures):
+            if cs.block_id_flag != BLOCK_ID_FLAG_COMMIT:
+                continue  # light: ignore everything not for the block
+            val = task.vals.validators[idx]
+            flat_pks.append(val.pub_key.bytes())
+            flat_msgs.append(task.commit.vote_sign_bytes(task.chain_id, idx))
+            flat_sigs.append(cs.signature)
+            sig_idxs.append(idx)
+            tallied += val.voting_power
+            if tallied > needed:
+                break
+        if tallied <= needed:
+            verdicts[t_i] = CommitVerdict(
+                False, NotEnoughVotingPowerError(got=tallied, needed=needed)
+            )
+            # drop this task's entries from the flat batch
+            del flat_pks[start:], flat_msgs[start:], flat_sigs[start:]
+            continue
+        spans[t_i] = (start, sig_idxs, tallied, needed)
+
+    if flat_pks:
+        if mesh is not None:
+            from tendermint_tpu.parallel.sharding import verify_batch_sharded
+
+            oks = verify_batch_sharded(flat_pks, flat_msgs, flat_sigs, mesh)
+        elif use_device is False:
+            from tendermint_tpu.crypto.ed25519_ref import verify_zip215
+
+            oks = [
+                verify_zip215(pk, m, s)
+                for pk, m, s in zip(flat_pks, flat_msgs, flat_sigs)
+            ]
+        else:
+            from tendermint_tpu.ops import verify_batch
+
+            oks = verify_batch(flat_pks, flat_msgs, flat_sigs)
+    else:
+        oks = []
+
+    for t_i, span in enumerate(spans):
+        if span is None:
+            continue
+        start, sig_idxs, _, _ = span
+        block_oks = oks[start : start + len(sig_idxs)]
+        bad = next((i for i, ok in enumerate(block_oks) if not ok), None)
+        if bad is None:
+            verdicts[t_i] = CommitVerdict(True)
+        else:
+            sig = tasks[t_i].commit.signatures[sig_idxs[bad]]
+            verdicts[t_i] = CommitVerdict(
+                False,
+                InvalidCommitError(
+                    f"wrong signature (#{sig_idxs[bad]}): "
+                    f"{sig.signature.hex().upper()}"
+                ),
+            )
+    return [v for v in verdicts]

@@ -94,8 +94,12 @@ class BlockExecutor:
         self.state_store = state_store
         self.app = app_client
         self.block_store = block_store
-        self.mempool = mempool or Mempool()
-        self.evidence_pool = evidence_pool or EvidencePool()
+        # `is not None`, NOT truthiness: an empty TxMempool has len() == 0
+        # and would be silently swapped for the no-op default.
+        self.mempool = mempool if mempool is not None else Mempool()
+        self.evidence_pool = (
+            evidence_pool if evidence_pool is not None else EvidencePool()
+        )
         self.event_publisher = event_publisher
         self._now = now or (lambda: Timestamp.from_unix_ns(_time.time_ns()))
         self._validate_cache: set = set()

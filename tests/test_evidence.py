@@ -1,0 +1,181 @@
+"""Evidence verification and pool tests (internal/evidence analog)."""
+
+import pytest
+
+from tendermint_tpu.encoding.canonical import (
+    SIGNED_MSG_TYPE_PREVOTE,
+    Timestamp,
+)
+from tendermint_tpu.evidence import EvidencePool, verify_duplicate_vote
+from tendermint_tpu.evidence.verify import (
+    InvalidEvidenceError,
+    verify_light_client_attack,
+)
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tests.helpers import CHAIN_ID, make_block_id, make_validators
+from tests.test_vote_set import signed_vote
+from tests.test_light import build_light_chain
+
+BASE_NS = 1_700_000_000_000_000_000
+
+
+def make_duplicate_evidence(privs, vset, idx=0, height=5):
+    v1 = signed_vote(privs[idx], vset, idx, height=height, block_id=make_block_id(b"a"))
+    v2 = signed_vote(privs[idx], vset, idx, height=height, block_id=make_block_id(b"b"))
+    return DuplicateVoteEvidence.new(
+        v1, v2, Timestamp.from_unix_ns(BASE_NS), vset
+    )
+
+
+class TestVerifyDuplicateVote:
+    def test_valid(self):
+        privs, vset = make_validators(4)
+        ev = make_duplicate_evidence(privs, vset)
+        verify_duplicate_vote(ev, CHAIN_ID, vset)
+
+    def test_same_block_id_rejected(self):
+        privs, vset = make_validators(4)
+        v1 = signed_vote(privs[0], vset, 0, height=5, block_id=make_block_id(b"a"))
+        ev = make_duplicate_evidence(privs, vset)
+        ev.vote_b = ev.vote_a
+        with pytest.raises(InvalidEvidenceError, match="same"):
+            verify_duplicate_vote(ev, CHAIN_ID, vset)
+
+    def test_bad_signature_rejected(self):
+        privs, vset = make_validators(4)
+        ev = make_duplicate_evidence(privs, vset)
+        ev.vote_b.signature = bytes(64)
+        with pytest.raises(InvalidEvidenceError, match="signature"):
+            verify_duplicate_vote(ev, CHAIN_ID, vset)
+
+    def test_unknown_validator_rejected(self):
+        privs, vset = make_validators(4)
+        other_privs, other_vset = make_validators(2, power=7)
+        ev = make_duplicate_evidence(privs, vset)
+        # verify against a set that doesn't contain the equivocator
+        from tendermint_tpu.crypto.keys import Ed25519PrivKey
+        from tendermint_tpu.types import Validator, ValidatorSet
+
+        stranger = ValidatorSet(
+            [Validator(Ed25519PrivKey.from_seed(b"\x99" * 32).pub_key(), 5)]
+        )
+        with pytest.raises(InvalidEvidenceError, match="not a validator"):
+            verify_duplicate_vote(ev, CHAIN_ID, stranger)
+
+
+class FakeStateStore:
+    def __init__(self, vset):
+        self.vset = vset
+
+    def load_validators(self, height):
+        return self.vset
+
+
+class TestEvidencePool:
+    def _pool_with_state(self, privs, vset, height=10):
+        from tendermint_tpu.state.state import State
+
+        pool = EvidencePool(state_store=FakeStateStore(vset))
+        state = State(
+            chain_id=CHAIN_ID,
+            last_block_height=height,
+            last_block_time=Timestamp.from_unix_ns(BASE_NS + 1_000_000_000),
+            validators=vset,
+            next_validators=vset,
+            last_validators=vset,
+        )
+        pool.set_state(state)
+        return pool
+
+    def test_add_and_reap(self):
+        privs, vset = make_validators(4)
+        pool = self._pool_with_state(privs, vset)
+        ev = make_duplicate_evidence(privs, vset)
+        pool.add_evidence(ev)
+        pending, size = pool.pending_evidence(-1)
+        assert len(pending) == 1 and size > 0
+        assert pending[0].hash() == ev.hash()
+        # idempotent
+        pool.add_evidence(ev)
+        assert len(pool.pending_evidence(-1)[0]) == 1
+
+    def test_committed_not_repending(self):
+        privs, vset = make_validators(4)
+        pool = self._pool_with_state(privs, vset)
+        ev = make_duplicate_evidence(privs, vset)
+        pool.add_evidence(ev)
+        pool.update(pool.state, [ev])
+        assert pool.pending_evidence(-1)[0] == []
+        assert pool.is_committed(ev)
+        with pytest.raises(InvalidEvidenceError, match="committed"):
+            pool.check_evidence([ev])
+
+    def test_report_conflicting_votes(self):
+        privs, vset = make_validators(4)
+        pool = self._pool_with_state(privs, vset)
+        v1 = signed_vote(privs[1], vset, 1, height=5, block_id=make_block_id(b"a"))
+        v2 = signed_vote(privs[1], vset, 1, height=5, block_id=make_block_id(b"b"))
+        pool.report_conflicting_votes(v1, v2)
+        assert len(pool.pending_evidence(-1)[0]) == 1
+
+    def test_expired_evidence_rejected_and_pruned(self):
+        privs, vset = make_validators(4)
+        pool = self._pool_with_state(privs, vset, height=10)
+        ev = make_duplicate_evidence(privs, vset, height=5)
+        pool.add_evidence(ev)
+        # Move state far into the future past both age limits.
+        from dataclasses import replace
+
+        future = replace(
+            pool.state,
+            last_block_height=5 + 200_000,
+            last_block_time=Timestamp.from_unix_ns(BASE_NS + int(100 * 3600 * 1e9)),
+        )
+        pool.update(future, [])
+        assert pool.pending_evidence(-1)[0] == []
+        with pytest.raises(InvalidEvidenceError, match="too old"):
+            pool.add_evidence(make_duplicate_evidence(privs, vset, height=5))
+
+    def test_power_mismatch_rejected(self):
+        privs, vset = make_validators(4)
+        pool = self._pool_with_state(privs, vset)
+        ev = make_duplicate_evidence(privs, vset)
+        ev.total_voting_power = 999
+        with pytest.raises(InvalidEvidenceError, match="total voting power"):
+            pool.add_evidence(ev)
+
+
+class TestVerifyLightClientAttack:
+    def test_equivocation_attack_verifies(self):
+        # Conflicting block at the same height as common: equivocation.
+        blocks, _, vset = build_light_chain(8)
+        forked, _, _ = build_light_chain(8, fork_at=5)
+        common = blocks[4].signed_header   # height 5 common? use height 4
+        common = blocks[3].signed_header   # height 4 (pre-fork, identical)
+        trusted = blocks[7].signed_header
+        from tendermint_tpu.types.evidence import LightClientAttackEvidence
+
+        ev = LightClientAttackEvidence(
+            conflicting_block=forked[7],
+            common_height=4,
+            total_voting_power=vset.total_voting_power(),
+            timestamp=common.header.time,
+        )
+        verify_light_client_attack(ev, common, trusted, vset)
+
+    def test_fabricated_commit_rejected(self):
+        blocks, _, vset = build_light_chain(8)
+        forked, _, _ = build_light_chain(8, fork_at=5)
+        forked[7].signed_header.commit.signatures[0].signature = bytes(64)
+        from tendermint_tpu.types.evidence import LightClientAttackEvidence
+
+        ev = LightClientAttackEvidence(
+            conflicting_block=forked[7],
+            common_height=4,
+            total_voting_power=vset.total_voting_power(),
+            timestamp=blocks[3].signed_header.header.time,
+        )
+        with pytest.raises(InvalidEvidenceError, match="signature|commit"):
+            verify_light_client_attack(
+                ev, blocks[3].signed_header, blocks[7].signed_header, vset
+            )

@@ -1,0 +1,220 @@
+"""Light client tests (light/client_test.go + verifier_test.go analog):
+sequential + skipping verification, backwards, expiry, and the detector."""
+
+import hashlib
+
+import pytest
+
+from tendermint_tpu.encoding.canonical import Timestamp
+from tendermint_tpu.light import (
+    DEFAULT_TRUST_LEVEL,
+    HeaderExpiredError,
+    InvalidHeaderError,
+    LightClient,
+    LightStore,
+    MemoryProvider,
+    TrustOptions,
+    verify,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)
+from tendermint_tpu.light.client import DivergedHeaderError
+from tendermint_tpu.types import (
+    BlockID,
+    Consensus,
+    Fraction,
+    Header,
+    LightBlock,
+    PartSetHeader,
+    SignedHeader,
+)
+from tests.helpers import CHAIN_ID, make_commit, make_validators
+
+BASE_NS = 1_700_000_000_000_000_000
+HOUR = 3600.0
+
+
+def build_light_chain(n_heights, n_vals=4, chain_id=CHAIN_ID, fork_at=None,
+                      fork_salt=b"fork"):
+    """Signed header chain with constant valset; optional fork suffix."""
+    privs, vset = make_validators(n_vals)
+    blocks = []
+    last_bid = BlockID()
+    for h in range(1, n_heights + 1):
+        salt = fork_salt if fork_at is not None and h >= fork_at else b""
+        header = Header(
+            version=Consensus(block=11),
+            chain_id=chain_id,
+            height=h,
+            time=Timestamp.from_unix_ns(BASE_NS + h * 1_000_000_000),
+            last_block_id=last_bid,
+            last_commit_hash=hashlib.sha256(b"lc%d" % h).digest(),
+            data_hash=hashlib.sha256(b"d%d" % h + salt).digest(),
+            validators_hash=vset.hash(),
+            next_validators_hash=vset.hash(),
+            consensus_hash=hashlib.sha256(b"cp").digest(),
+            app_hash=hashlib.sha256(b"app%d" % h).digest(),
+            last_results_hash=b"",
+            evidence_hash=b"",
+            proposer_address=vset.validators[0].address,
+        )
+        bid = BlockID(
+            header.hash(),
+            PartSetHeader(1, hashlib.sha256(b"parts%d" % h + salt).digest()),
+        )
+        commit = make_commit(
+            bid, h, 0, vset, privs, chain_id=chain_id,
+            time_ns=BASE_NS + h * 1_000_000_000,
+        )
+        blocks.append(
+            LightBlock(
+                signed_header=SignedHeader(header=header, commit=commit),
+                validator_set=vset.copy(),
+            )
+        )
+        last_bid = bid
+    return blocks, privs, vset
+
+
+def now_at(height_ns=None):
+    return Timestamp.from_unix_ns(height_ns or (BASE_NS + 1_000_000_000_000))
+
+
+class TestVerifier:
+    def test_adjacent_ok(self):
+        blocks, _, vset = build_light_chain(3)
+        verify_adjacent(
+            blocks[0].signed_header, blocks[1].signed_header, vset,
+            trusting_period=10 * HOUR, now=now_at(), max_clock_drift=10.0,
+        )
+
+    def test_non_adjacent_ok(self):
+        blocks, _, vset = build_light_chain(5)
+        verify_non_adjacent(
+            blocks[0].signed_header, vset, blocks[4].signed_header, vset,
+            trusting_period=10 * HOUR, now=now_at(), max_clock_drift=10.0,
+            trust_level=Fraction(1, 3),
+        )
+
+    def test_expired_header_rejected(self):
+        blocks, _, vset = build_light_chain(3)
+        with pytest.raises(HeaderExpiredError):
+            verify_adjacent(
+                blocks[0].signed_header, blocks[1].signed_header, vset,
+                trusting_period=1.0,
+                now=Timestamp.from_unix_ns(BASE_NS + 10_000_000_000_000),
+                max_clock_drift=10.0,
+            )
+
+    def test_tampered_commit_rejected(self):
+        blocks, _, vset = build_light_chain(3)
+        bad = blocks[1].signed_header
+        bad.commit.signatures[0].signature = bytes(64)
+        with pytest.raises(InvalidHeaderError):
+            verify_adjacent(
+                blocks[0].signed_header, bad, vset,
+                trusting_period=10 * HOUR, now=now_at(), max_clock_drift=10.0,
+            )
+
+    def test_backwards(self):
+        blocks, _, _ = build_light_chain(3)
+        verify_backwards(
+            blocks[1].signed_header.header, blocks[2].signed_header.header
+        )
+        with pytest.raises(InvalidHeaderError):
+            verify_backwards(
+                blocks[0].signed_header.header, blocks[2].signed_header.header
+            )
+
+
+def make_client(blocks, witness_blocks=None, sequential=False, height=1):
+    primary = MemoryProvider(CHAIN_ID, blocks)
+    witnesses = []
+    if witness_blocks is not None:
+        witnesses.append(MemoryProvider(CHAIN_ID, witness_blocks))
+    return LightClient(
+        CHAIN_ID,
+        TrustOptions(period=10 * HOUR, height=height, hash=blocks[height - 1].hash()),
+        primary,
+        witnesses,
+        sequential=sequential,
+        now=now_at,
+    ), primary, witnesses
+
+
+class TestLightClient:
+    def test_skipping_verification(self):
+        blocks, _, _ = build_light_chain(20)
+        client, _, _ = make_client(blocks)
+        lb = client.verify_light_block_at_height(20)
+        assert lb.height == 20
+        assert client.latest_trusted().height == 20
+
+    def test_sequential_verification(self):
+        blocks, _, _ = build_light_chain(6)
+        client, _, _ = make_client(blocks, sequential=True)
+        lb = client.verify_light_block_at_height(6)
+        assert lb.height == 6
+        # Sequential stores every interim header.
+        for h in range(1, 7):
+            assert client.trusted_light_block(h) is not None
+
+    def test_backwards_verification(self):
+        blocks, _, _ = build_light_chain(10)
+        client, _, _ = make_client(blocks, height=8)
+        lb = client.verify_light_block_at_height(3)
+        assert lb.height == 3
+
+    def test_update_to_latest(self):
+        blocks, _, _ = build_light_chain(7)
+        client, _, _ = make_client(blocks)
+        lb = client.update()
+        assert lb is not None and lb.height == 7
+
+    def test_wrong_anchor_hash_rejected(self):
+        blocks, _, _ = build_light_chain(3)
+        primary = MemoryProvider(CHAIN_ID, blocks)
+        with pytest.raises(Exception, match="hash"):
+            LightClient(
+                CHAIN_ID,
+                TrustOptions(period=10 * HOUR, height=1, hash=b"\x01" * 32),
+                primary,
+                [],
+                now=now_at,
+            )
+
+    def test_detector_flags_forked_witness(self):
+        blocks, _, _ = build_light_chain(10)
+        forked, _, _ = build_light_chain(10, fork_at=6)
+        client, primary, witnesses = make_client(blocks, witness_blocks=forked)
+        with pytest.raises(DivergedHeaderError) as exc:
+            client.verify_light_block_at_height(10)
+        assert exc.value.evidence.conflicting_block.height == 10
+        # Evidence was reported to the primary.
+        assert primary.evidence
+
+    def test_honest_witness_no_evidence(self):
+        blocks, _, _ = build_light_chain(10)
+        client, primary, witnesses = make_client(blocks, witness_blocks=blocks)
+        lb = client.verify_light_block_at_height(10)
+        assert lb.height == 10
+        assert not primary.evidence and not witnesses[0].evidence
+
+
+class TestBadWitness:
+    def test_unverifiable_witness_dropped_not_attack(self):
+        """A witness returning garbage (unverifiable commit) must be dropped,
+        not treated as a proven attack (detector examineConflictingHeader)."""
+        blocks, _, _ = build_light_chain(10)
+        garbage, _, _ = build_light_chain(10, fork_at=2)
+        # Corrupt the garbage chain's commits so they can't verify.
+        for lb in garbage:
+            for cs in lb.signed_header.commit.signatures:
+                cs.signature = bytes(64)
+            lb.signed_header.commit._hash = None
+        client, primary, witnesses = make_client(blocks, witness_blocks=garbage)
+        lb = client.verify_light_block_at_height(10)
+        assert lb.height == 10
+        assert client.witnesses == []  # bad witness removed
+        assert not primary.evidence  # no bogus evidence broadcast

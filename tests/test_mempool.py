@@ -1,0 +1,125 @@
+"""TxMempool tests (internal/mempool/mempool_test.go analog)."""
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.mempool import LRUTxCache, MempoolConfig, TxMempool
+from tendermint_tpu.types.block import tx_hash
+
+
+class PriorityApp(KVStoreApplication):
+    """CheckTx priority = int after the last ':' when present."""
+
+    def check_tx(self, req):
+        res = super().check_tx(req)
+        if res.is_ok() and b":" in req.tx:
+            try:
+                res.priority = int(req.tx.rsplit(b":", 1)[1])
+            except ValueError:
+                pass
+        return res
+
+
+def make_mempool(config=None):
+    client = LocalClient(PriorityApp())
+    client.start()
+    return TxMempool(config or MempoolConfig(), client)
+
+
+class TestLRUTxCache:
+    def test_push_dedupe_and_evict(self):
+        c = LRUTxCache(2)
+        assert c.push(b"a") and c.push(b"b")
+        assert not c.push(b"a")
+        assert c.push(b"c")  # evicts b (a was refreshed)
+        assert not c.has(b"b") and c.has(b"a") and c.has(b"c")
+
+
+class TestTxMempool:
+    def test_check_tx_admits_and_dedupes(self):
+        mp = make_mempool()
+        res = mp.check_tx(b"k=v")
+        assert res.is_ok() and len(mp) == 1
+        with pytest.raises(KeyError, match="cache"):
+            mp.check_tx(b"k=v")
+
+    def test_invalid_tx_rejected(self):
+        mp = make_mempool()
+        res = mp.check_tx(bytes([0xFF, 0xFE]))  # not utf-8: invalid format
+        assert not res.is_ok()
+        assert len(mp) == 0
+
+    def test_priority_ordering_in_reap(self):
+        mp = make_mempool()
+        for tx in [b"a=1:5", b"b=2:50", b"c=3:10"]:
+            mp.check_tx(tx)
+        assert mp.reap_max_bytes_max_gas(-1, -1) == [b"b=2:50", b"c=3:10", b"a=1:5"]
+        assert mp.reap_max_txs(2) == [b"b=2:50", b"c=3:10"]
+
+    def test_reap_respects_max_bytes(self):
+        mp = make_mempool()
+        mp.check_tx(b"a=" + b"x" * 100 + b":9")
+        mp.check_tx(b"b=1:5")
+        # Reaping stops at the FIRST over-budget tx (priority order is
+        # strict; the small low-priority tx may not leapfrog the big one).
+        assert mp.reap_max_bytes_max_gas(20, -1) == []
+        assert mp.reap_max_bytes_max_gas(200, -1) == [
+            b"a=" + b"x" * 100 + b":9",
+            b"b=1:5",
+        ]
+
+    def test_update_removes_committed_and_rechecks(self):
+        mp = make_mempool()
+        mp.check_tx(b"a=1:5")
+        mp.check_tx(b"b=2:9")
+        mp.lock()
+        try:
+            mp.update(
+                1, [b"a=1:5"], [abci.ExecTxResult(code=0)],
+            )
+        finally:
+            mp.unlock()
+        assert mp.tx_list() == [b"b=2:9"]
+        # committed tx stays cached -> re-submission rejected
+        with pytest.raises(KeyError):
+            mp.check_tx(b"a=1:5")
+
+    def test_eviction_by_priority_when_full(self):
+        mp = make_mempool(MempoolConfig(size=2))
+        mp.check_tx(b"a=1:1")
+        mp.check_tx(b"b=2:2")
+        mp.check_tx(b"c=3:50")  # evicts the lowest priority (a)
+        txs = mp.tx_list()
+        assert b"a=1:1" not in txs and b"c=3:50" in txs
+        with pytest.raises(OverflowError):
+            mp.check_tx(b"d=4:0")  # lower than everything: no room
+
+    def test_ttl_num_blocks(self):
+        mp = make_mempool(MempoolConfig(ttl_num_blocks=1, recheck=False))
+        mp.check_tx(b"a=1:5")
+        mp.lock()
+        try:
+            mp.update(3, [], [])
+        finally:
+            mp.unlock()
+        assert len(mp) == 0
+
+    def test_sender_dedupe(self):
+        mp = make_mempool()
+        mp.check_tx(b"a=1:5", sender="alice")
+        with pytest.raises(KeyError, match="sender"):
+            mp.check_tx(b"b=2:5", sender="alice")
+
+    def test_txs_available_signal(self):
+        mp = make_mempool()
+        mp.enable_txs_available()
+        assert not mp.txs_available().is_set()
+        mp.check_tx(b"a=1:5")
+        assert mp.txs_available().is_set()
+
+    def test_oversize_tx_rejected(self):
+        mp = make_mempool(MempoolConfig(max_tx_bytes=10))
+        with pytest.raises(ValueError, match="size"):
+            mp.check_tx(b"x" * 11)
