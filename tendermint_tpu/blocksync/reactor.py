@@ -1,0 +1,129 @@
+"""Blocksync reactor: channel 0x40 (internal/blocksync/reactor.go:27).
+
+Wire messages (1 tag byte + payload): BlockRequest{height},
+BlockResponse{block proto}, StatusRequest{}, StatusResponse{base,height},
+NoBlockResponse{height}. Serves blocks from the local store and feeds
+fetched blocks into the syncer's pool; on catch-up the node switches to
+consensus (reactor.go:507-529 via the on_caught_up hook).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time as _time
+from typing import Optional
+
+from tendermint_tpu.blocksync.pool import BlockPool
+from tendermint_tpu.blocksync.syncer import BlockSyncer, PeerTransport
+from tendermint_tpu.p2p.router import Channel, Envelope, Router
+from tendermint_tpu.storage.blockstore import BlockStore
+from tendermint_tpu.types.block import Block
+
+BLOCKSYNC_CHANNEL = 0x40
+
+TAG_BLOCK_REQUEST = 1
+TAG_BLOCK_RESPONSE = 2
+TAG_NO_BLOCK_RESPONSE = 3
+TAG_STATUS_REQUEST = 4
+TAG_STATUS_RESPONSE = 5
+
+
+class BlockSyncReactor(PeerTransport):
+    def __init__(
+        self,
+        syncer: Optional[BlockSyncer],
+        block_store: BlockStore,
+        router: Router,
+    ):
+        self.syncer = syncer  # None on nodes that only serve
+        self.block_store = block_store
+        self.channel = router.open_channel(BLOCKSYNC_CHANNEL)
+        self._stop_flag = threading.Event()
+        self._threads = []
+        if syncer is not None:
+            syncer.transport = self
+
+    # --- PeerTransport --------------------------------------------------------
+
+    def request_block(self, peer_id: str, height: int) -> None:
+        from tendermint_tpu.p2p.router import Envelope
+
+        self.channel.send(
+            Envelope(
+                BLOCKSYNC_CHANNEL,
+                bytes([TAG_BLOCK_REQUEST]) + struct.pack(">q", height),
+                to_peer=peer_id,
+            )
+        )
+
+    def broadcast_status_request(self) -> None:
+        self.channel.broadcast(bytes([TAG_STATUS_REQUEST]))
+
+    # --- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop_flag.clear()
+        t = threading.Thread(target=self._recv_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.syncer is not None:
+            t2 = threading.Thread(target=self._status_loop, daemon=True)
+            t2.start()
+            self._threads.append(t2)
+            self.syncer.start()
+
+    def stop(self) -> None:
+        self._stop_flag.set()
+        if self.syncer is not None:
+            self.syncer.stop()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
+
+    def _status_loop(self) -> None:
+        while not self._stop_flag.is_set():
+            self.broadcast_status_request()
+            self._stop_flag.wait(1.0)
+
+    # --- inbound --------------------------------------------------------------
+
+    def _recv_loop(self) -> None:
+        while not self._stop_flag.is_set():
+            env = self.channel.receive(timeout=0.2)
+            if env is None:
+                continue
+            try:
+                self._handle(env)
+            except Exception:
+                pass
+
+    def _handle(self, env: Envelope) -> None:
+        tag = env.message[0]
+        if tag == TAG_BLOCK_REQUEST:
+            (height,) = struct.unpack_from(">q", env.message, 1)
+            block = self.block_store.load_block(height)
+            if block is not None:
+                resp = bytes([TAG_BLOCK_RESPONSE]) + block.to_proto_bytes()
+            else:
+                resp = bytes([TAG_NO_BLOCK_RESPONSE]) + struct.pack(">q", height)
+            self.channel.send(
+                Envelope(BLOCKSYNC_CHANNEL, resp, to_peer=env.from_peer)
+            )
+        elif tag == TAG_BLOCK_RESPONSE:
+            if self.syncer is not None:
+                block = Block.from_proto_bytes(env.message[1:])
+                self.syncer.pool.add_block(env.from_peer, block)
+        elif tag == TAG_STATUS_REQUEST:
+            base, height = self.block_store.base(), self.block_store.height()
+            self.channel.send(
+                Envelope(
+                    BLOCKSYNC_CHANNEL,
+                    bytes([TAG_STATUS_RESPONSE]) + struct.pack(">qq", base, height),
+                    to_peer=env.from_peer,
+                )
+            )
+        elif tag == TAG_STATUS_RESPONSE:
+            if self.syncer is not None:
+                base, height = struct.unpack_from(">qq", env.message, 1)
+                self.syncer.pool.set_peer_range(env.from_peer, max(base, 1), height)

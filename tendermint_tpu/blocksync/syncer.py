@@ -72,9 +72,10 @@ class BlockSyncer:
 
     def stop(self) -> None:
         self._stop_flag.set()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
-            self._thread = None
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10)
+        self._thread = None
 
     def _run(self) -> None:
         while not self._stop_flag.is_set():

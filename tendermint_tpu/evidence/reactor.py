@@ -1,0 +1,48 @@
+"""Evidence reactor: gossip on channel 0x38
+(internal/evidence/reactor.go). Pending evidence is broadcast; received
+evidence is verified into the pool and re-gossiped if new."""
+
+from __future__ import annotations
+
+import threading
+
+from tendermint_tpu.evidence.pool import EvidencePool
+from tendermint_tpu.p2p.router import Channel, Envelope, Router
+from tendermint_tpu.types.evidence import evidence_from_proto_bytes
+
+EVIDENCE_CHANNEL = 0x38
+
+
+class EvidenceReactor:
+    def __init__(self, pool: EvidencePool, router: Router):
+        self.pool = pool
+        self.channel = router.open_channel(EVIDENCE_CHANNEL)
+        self._stop_flag = threading.Event()
+        self._thread = None
+
+    def start(self) -> None:
+        self._stop_flag.clear()
+        self._thread = threading.Thread(target=self._recv_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_flag.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def broadcast_evidence(self, ev) -> None:
+        self.channel.broadcast(ev.to_proto_bytes())
+
+    def _recv_loop(self) -> None:
+        while not self._stop_flag.is_set():
+            env = self.channel.receive(timeout=0.2)
+            if env is None:
+                continue
+            try:
+                ev = evidence_from_proto_bytes(env.message)
+                if not self.pool.is_pending(ev) and not self.pool.is_committed(ev):
+                    self.pool.add_evidence(ev)
+                    self.channel.broadcast(env.message)
+            except Exception:
+                pass  # invalid evidence from peer: drop

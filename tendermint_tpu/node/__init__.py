@@ -1,0 +1,5 @@
+"""Node assembly (reference: node/)."""
+
+from tendermint_tpu.node.node import Node, NodeConfig
+
+__all__ = ["Node", "NodeConfig"]

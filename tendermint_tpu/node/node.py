@@ -1,0 +1,265 @@
+"""Node: the dependency-injection container wiring every component.
+
+Mirrors node/node.go makeNode (node.go:121-400) + OnStart ordering
+(node.go:403-519): stores -> genesis/state -> ABCI client -> mempool /
+evidence -> executor -> consensus -> router + reactors -> (optionally)
+blocksync until caught up, then consensus.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+from dataclasses import dataclass, field as dc_field
+from typing import List, Optional
+
+from tendermint_tpu.abci.client import AbciClient, LocalClient
+from tendermint_tpu.abci import types as abci_types
+from tendermint_tpu.blocksync.reactor import BlockSyncReactor
+from tendermint_tpu.blocksync.syncer import BlockSyncer
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.wal import WAL, NilWAL
+from tendermint_tpu.evidence.pool import EvidencePool
+from tendermint_tpu.evidence.reactor import EvidenceReactor
+from tendermint_tpu.mempool.mempool import MempoolConfig, TxMempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.peermanager import PeerAddress, PeerManager
+from tendermint_tpu.p2p.pex import PexReactor
+from tendermint_tpu.p2p.router import Router
+from tendermint_tpu.p2p.transport import (
+    MemoryNetwork,
+    NodeInfo,
+    TCPTransport,
+    Transport,
+)
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.privval.base import PrivValidator
+from tendermint_tpu.state import StateStore, state_from_genesis
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.storage import MemDB
+from tendermint_tpu.storage.blockstore import BlockStore
+from tendermint_tpu.types.genesis import GenesisDoc
+
+
+@dataclass
+class NodeConfig:
+    """config/config.go condensed: the knobs the node assembly needs."""
+
+    home: str = ""
+    chain_id: str = ""
+    listen_addr: str = "127.0.0.1:0"
+    persistent_peers: List[str] = dc_field(default_factory=list)
+    mempool: MempoolConfig = dc_field(default_factory=MempoolConfig)
+    blocksync: bool = True
+    wal_enabled: bool = True
+    max_connections: int = 16
+    moniker: str = "tpu-node"
+
+
+class Node:
+    def __init__(
+        self,
+        config: NodeConfig,
+        genesis: GenesisDoc,
+        app_client: AbciClient,
+        priv_validator: Optional[PrivValidator] = None,
+        node_key: Optional[NodeKey] = None,
+        transport: Optional[Transport] = None,
+        memory_network: Optional[MemoryNetwork] = None,
+    ):
+        self.config = config
+        self.genesis = genesis
+        self.app = app_client
+
+        # --- identity (node.go:85-103) --------------------------------------
+        if node_key is None:
+            if config.home:
+                os.makedirs(config.home, exist_ok=True)
+                node_key = NodeKey.load_or_gen(
+                    os.path.join(config.home, "node_key.json")
+                )
+            else:
+                node_key = NodeKey.generate()
+        self.node_key = node_key
+        if priv_validator is None and config.home:
+            priv_validator = FilePV.load_or_generate(
+                os.path.join(config.home, "priv_validator_key.json"),
+                os.path.join(config.home, "priv_validator_state.json"),
+            )
+        self.priv_validator = priv_validator
+
+        # --- stores + state (node.go:136-156) --------------------------------
+        self.state_store = StateStore(MemDB())
+        self.block_store = BlockStore(MemDB())
+        stored = self.state_store.load()
+        if stored is None:
+            self.sm_state = state_from_genesis(genesis)
+            app_client.start()
+            init = app_client.init_chain(
+                abci_types.RequestInitChain(
+                    time=genesis.genesis_time,
+                    chain_id=genesis.chain_id,
+                    consensus_params=genesis.consensus_params,
+                    validators=[],
+                    app_state_bytes=genesis.app_state,
+                    initial_height=genesis.initial_height,
+                )
+            )
+            if init.app_hash:
+                self.sm_state.app_hash = init.app_hash
+            if init.validators:
+                from tendermint_tpu.types.validator_set import ValidatorSet
+
+                vals = [vu.to_validator() for vu in init.validators]
+                self.sm_state.validators = ValidatorSet(vals)
+                self.sm_state.next_validators = ValidatorSet(vals)
+                self.sm_state.next_validators.increment_proposer_priority(1)
+            self.state_store.save(self.sm_state)
+        else:
+            self.sm_state = stored
+            app_client.start()
+
+        # --- pools + executor (node.go:258-297) ------------------------------
+        self.mempool = TxMempool(config.mempool, app_client)
+        self.evidence_pool = EvidencePool(
+            state_store=self.state_store, block_store=self.block_store
+        )
+        self.evidence_pool.set_state(self.sm_state)
+        self.block_exec = BlockExecutor(
+            self.state_store,
+            app_client,
+            self.block_store,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+        )
+
+        # --- p2p (node.go:206-256) -------------------------------------------
+        if transport is None:
+            if memory_network is not None:
+                transport = memory_network.transport(config.listen_addr)
+            else:
+                transport = TCPTransport(self.node_key)
+                transport.listen(config.listen_addr)
+        self.transport = transport
+        listen_addr = getattr(transport, "listen_addr", config.listen_addr)
+        self.node_info = NodeInfo(
+            node_id=self.node_key.node_id,
+            network=genesis.chain_id,
+            moniker=config.moniker,
+            listen_addr=listen_addr,
+        )
+        self.peer_manager = PeerManager(
+            self.node_key.node_id, max_connected=config.max_connections
+        )
+        self.router = Router(self.node_info, self.peer_manager, transport)
+
+        # --- consensus (node.go:297-325) -------------------------------------
+        wal: WAL
+        if config.wal_enabled and config.home:
+            wal = WAL(os.path.join(config.home, "cs.wal"))
+        else:
+            wal = NilWAL()
+        self.consensus = ConsensusState(
+            self.sm_state,
+            self.block_exec,
+            self.block_store,
+            priv_validator=self.priv_validator,
+            wal=wal,
+        )
+        self.consensus_reactor = ConsensusReactor(self.consensus, self.router)
+        self.mempool_reactor = MempoolReactor(self.mempool, self.router)
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool, self.router)
+
+        # --- blocksync (node.go:327-356) -------------------------------------
+        self._caught_up_event = threading.Event()
+        if config.blocksync:
+            self.syncer = BlockSyncer(
+                self.sm_state,
+                self.block_exec,
+                self.block_store,
+                transport=None,
+                on_caught_up=self._switch_to_consensus,
+            )
+        else:
+            self.syncer = None
+        self.blocksync_reactor = BlockSyncReactor(
+            self.syncer, self.block_store, self.router
+        )
+        self.pex_reactor = PexReactor(self.peer_manager, self.router)
+        self._started = False
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """OnStart ordering (node.go:403-519)."""
+        self.router.start()
+        self.pex_reactor.start()
+        self.evidence_reactor.start()
+        self.mempool_reactor.start()
+        self.consensus_reactor.start()
+        self.blocksync_reactor.start()
+        for peer in self.config.persistent_peers:
+            self.peer_manager.add_address(PeerAddress.parse(peer), persistent=True)
+        if self.syncer is None:
+            self._switch_to_consensus(self.sm_state)
+        else:
+            # If there's nothing to sync from within a grace period, start
+            # consensus anyway (single node / all peers at same height).
+            threading.Thread(
+                target=self._blocksync_grace, daemon=True
+            ).start()
+        self._started = True
+
+    def _blocksync_grace(self) -> None:
+        deadline = _time.monotonic() + 2.0
+        while _time.monotonic() < deadline:
+            if self._caught_up_event.is_set():
+                return
+            if self.syncer.pool.max_peer_height() > self.block_store.height():
+                return  # real sync in progress; on_caught_up will fire
+            _time.sleep(0.1)
+        if not self._caught_up_event.is_set():
+            self._switch_to_consensus(self.syncer.state)
+
+    def _switch_to_consensus(self, state) -> None:
+        """blocksync reactor.go:507-529 SwitchToConsensus."""
+        if self._caught_up_event.is_set():
+            return
+        self._caught_up_event.set()
+        if self.syncer is not None:
+            self.syncer.stop()
+            # Adopt the synced state.
+            self.consensus._reconstruct_and_update(self.syncer.state)
+        self.consensus.start()
+
+    def stop(self) -> None:
+        try:
+            self.consensus.stop()
+        except Exception:
+            pass
+        for r in (
+            self.blocksync_reactor,
+            self.consensus_reactor,
+            self.mempool_reactor,
+            self.evidence_reactor,
+            self.pex_reactor,
+        ):
+            try:
+                r.stop()
+            except Exception:
+                pass
+        self.router.stop()
+        self._started = False
+
+    # --- convenience ---------------------------------------------------------
+
+    @property
+    def height(self) -> int:
+        return self.block_store.height()
+
+    def submit_tx(self, tx: bytes) -> None:
+        """Local tx submission: CheckTx + gossip (the RPC broadcast path)."""
+        self.mempool_reactor.check_and_broadcast_tx(tx)

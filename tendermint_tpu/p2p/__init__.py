@@ -1,0 +1,7 @@
+"""P2P networking (reference: internal/p2p/): encrypted, multiplexed
+TCP control plane. The accelerator is a data-plane sidecar — consensus
+wire traffic stays on sockets (SURVEY.md §5, distributed backend)."""
+
+from tendermint_tpu.p2p.key import NodeID, NodeKey
+
+__all__ = ["NodeID", "NodeKey"]

@@ -1,0 +1,161 @@
+"""Authenticated encrypted connections (internal/p2p/conn/secret_connection.go).
+
+Same construction as the reference in spirit: X25519 ephemeral ECDH →
+HKDF-SHA256 → two ChaCha20-Poly1305 keys (one per direction, chosen by
+ephemeral-key sort order), then each side signs the session challenge
+with its ed25519 identity key and sends (pubkey, sig) encrypted. Frames
+are fixed 1024-byte chunks sealed with a 12-byte LE counter nonce, as in
+the reference (secret_connection.go:92-181, deriveSecrets:337). The
+transcript hash here is HKDF over sorted ephemerals (the reference uses
+a Merlin transcript; byte-level wire compat is not a goal — SURVEY.md §7
+step 7 'compatible-in-spirit').
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import struct
+from typing import Optional, Tuple
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    PublicFormat,
+)
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey, Ed25519PubKey, PubKey
+
+DATA_LEN_SIZE = 4
+DATA_MAX_SIZE = 1024
+TOTAL_FRAME_SIZE = DATA_MAX_SIZE + DATA_LEN_SIZE
+AEAD_TAG_SIZE = 16
+SEALED_FRAME_SIZE = TOTAL_FRAME_SIZE + AEAD_TAG_SIZE
+
+
+def _hkdf(secret: bytes, info: bytes, length: int) -> bytes:
+    """HKDF-SHA256 (extract with zero salt + expand)."""
+    prk = _hmac.new(b"\x00" * 32, secret, hashlib.sha256).digest()
+    okm = b""
+    t = b""
+    i = 1
+    while len(okm) < length:
+        t = _hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        okm += t
+        i += 1
+    return okm[:length]
+
+
+class SecretConnectionError(Exception):
+    pass
+
+
+class SecretConnection:
+    """Wraps a stream-like object (must expose sendall/recv_exact)."""
+
+    def __init__(self, stream, local_priv: Ed25519PrivKey):
+        self._stream = stream
+        self._local_priv = local_priv
+        self.remote_pubkey: Optional[PubKey] = None
+        self._send_cipher: Optional[ChaCha20Poly1305] = None
+        self._recv_cipher: Optional[ChaCha20Poly1305] = None
+        self._send_nonce = 0
+        self._recv_nonce = 0
+        self._recv_buffer = b""
+        self._handshake()
+
+    # --- handshake -----------------------------------------------------------
+
+    def _handshake(self) -> None:
+        """secret_connection.go MakeSecretConnection."""
+        eph_priv = X25519PrivateKey.generate()
+        eph_pub = eph_priv.public_key().public_bytes(
+            Encoding.Raw, PublicFormat.Raw
+        )
+        # 1. Exchange ephemeral pubkeys in the clear.
+        self._stream.sendall(eph_pub)
+        remote_eph = self._stream.recv_exact(32)
+        # 2. Shared secret + key derivation. Key order by ephemeral sort:
+        # the lexicographically lower key is the "first" party.
+        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+        lo, hi = sorted([eph_pub, remote_eph])
+        material = _hkdf(shared, b"TENDERMINT_TPU_SECRET_CONNECTION" + lo + hi, 96)
+        key1, key2, challenge = material[:32], material[32:64], material[64:96]
+        if eph_pub == lo:
+            send_key, recv_key = key1, key2
+        else:
+            send_key, recv_key = key2, key1
+        self._send_cipher = ChaCha20Poly1305(send_key)
+        self._recv_cipher = ChaCha20Poly1305(recv_key)
+        # 3. Authenticate: sign the challenge, swap (pubkey, sig) encrypted.
+        sig = self._local_priv.sign(challenge)
+        auth = self._local_priv.pub_key().bytes() + sig
+        self.send(auth)
+        remote_auth = self.recv()
+        if len(remote_auth) != 32 + 64:
+            raise SecretConnectionError("malformed auth message")
+        remote_pub = Ed25519PubKey(remote_auth[:32])
+        if not remote_pub.verify_signature(challenge, remote_auth[32:]):
+            raise SecretConnectionError("challenge verification failed")
+        self.remote_pubkey = remote_pub
+
+    # --- framing -------------------------------------------------------------
+
+    def _nonce(self, n: int) -> bytes:
+        # 12-byte nonce: 4 zero bytes + u64 LE counter (reference layout).
+        return b"\x00" * 4 + struct.pack("<Q", n)
+
+    def send(self, data: bytes) -> None:
+        """Chunk into sealed 1024-byte frames (secret_connection.go Write)."""
+        view = memoryview(data)
+        while True:
+            chunk = view[:DATA_MAX_SIZE]
+            view = view[DATA_MAX_SIZE:]
+            frame = struct.pack("<I", len(chunk)) + bytes(chunk)
+            frame += b"\x00" * (TOTAL_FRAME_SIZE - len(frame))
+            sealed = self._send_cipher.encrypt(
+                self._nonce(self._send_nonce), frame, None
+            )
+            self._send_nonce += 1
+            self._stream.sendall(sealed)
+            if not view:
+                break
+
+    def recv(self) -> bytes:
+        """One logical message may span frames only via caller protocol;
+        recv returns one frame's payload."""
+        sealed = self._stream.recv_exact(SEALED_FRAME_SIZE)
+        try:
+            frame = self._recv_cipher.decrypt(
+                self._nonce(self._recv_nonce), sealed, None
+            )
+        except Exception as e:
+            raise SecretConnectionError(f"failed to decrypt frame: {e}") from e
+        self._recv_nonce += 1
+        (length,) = struct.unpack_from("<I", frame)
+        if length > DATA_MAX_SIZE:
+            raise SecretConnectionError("frame length exceeds max")
+        return frame[DATA_LEN_SIZE : DATA_LEN_SIZE + length]
+
+    # --- length-prefixed message helpers ------------------------------------
+
+    def send_msg(self, msg: bytes) -> None:
+        """Length-prefixed message of arbitrary size over frames."""
+        self.send(struct.pack("<I", len(msg)) + msg)
+
+    def recv_msg(self, max_size: int = 64 * 1024 * 1024) -> bytes:
+        while len(self._recv_buffer) < 4:
+            self._recv_buffer += self.recv()
+        (length,) = struct.unpack_from("<I", self._recv_buffer)
+        if length > max_size:
+            raise SecretConnectionError(f"message size {length} exceeds max")
+        needed = 4 + length
+        while len(self._recv_buffer) < needed:
+            self._recv_buffer += self.recv()
+        msg = self._recv_buffer[4:needed]
+        self._recv_buffer = self._recv_buffer[needed:]
+        return msg

@@ -1,0 +1,234 @@
+"""P2P stack tests: secret connection, transports, router, peer manager
+(internal/p2p tests analog, memory transport substituting for sockets
+where possible per SURVEY.md §4)."""
+
+import queue
+import socket
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.p2p.key import NodeKey, node_id_from_pubkey, validate_node_id
+from tendermint_tpu.p2p.peermanager import PeerAddress, PeerManager, PeerUpdate
+from tendermint_tpu.p2p.router import Envelope, Router
+from tendermint_tpu.p2p.secret_connection import SecretConnection, SecretConnectionError
+from tendermint_tpu.p2p.transport import (
+    MemoryNetwork,
+    NodeInfo,
+    TCPTransport,
+)
+
+CHAIN = "p2p-chain"
+
+
+class _PipeStream:
+    """Stream over a socketpair end."""
+
+    def __init__(self, sock):
+        self.sock = sock
+
+    def sendall(self, data):
+        self.sock.sendall(data)
+
+    def recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("EOF")
+            buf += chunk
+        return buf
+
+
+class TestSecretConnection:
+    def _pair(self):
+        a, b = socket.socketpair()
+        ka = Ed25519PrivKey.from_seed(b"\x01" * 32)
+        kb = Ed25519PrivKey.from_seed(b"\x02" * 32)
+        out = {}
+
+        def responder():
+            out["b"] = SecretConnection(_PipeStream(b), kb)
+
+        t = threading.Thread(target=responder)
+        t.start()
+        sca = SecretConnection(_PipeStream(a), ka)
+        t.join(timeout=5)
+        return sca, out["b"], ka, kb
+
+    def test_handshake_authenticates_keys(self):
+        sca, scb, ka, kb = self._pair()
+        assert sca.remote_pubkey.bytes() == kb.pub_key().bytes()
+        assert scb.remote_pubkey.bytes() == ka.pub_key().bytes()
+
+    def test_bidirectional_messages(self):
+        sca, scb, _, _ = self._pair()
+        sca.send_msg(b"hello from a")
+        scb.send_msg(b"hello from b" * 500)  # multi-frame
+        assert scb.recv_msg() == b"hello from a"
+        assert sca.recv_msg() == b"hello from b" * 500
+
+    def test_tampered_ciphertext_rejected(self):
+        a, b = socket.socketpair()
+        ka = Ed25519PrivKey.from_seed(b"\x01" * 32)
+        kb = Ed25519PrivKey.from_seed(b"\x02" * 32)
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.update(b_conn=SecretConnection(_PipeStream(b), kb))
+        )
+        t.start()
+        sca = SecretConnection(_PipeStream(a), ka)
+        t.join(timeout=5)
+        scb = out["b_conn"]
+        # Write a corrupted sealed frame directly into the socket.
+        from tendermint_tpu.p2p.secret_connection import SEALED_FRAME_SIZE
+
+        a.sendall(b"\x00" * SEALED_FRAME_SIZE)
+        with pytest.raises(SecretConnectionError):
+            scb.recv()
+
+
+class TestNodeKey:
+    def test_node_id_format(self, tmp_path):
+        nk = NodeKey.load_or_gen(str(tmp_path / "nk.json"))
+        validate_node_id(nk.node_id)
+        nk2 = NodeKey.load_or_gen(str(tmp_path / "nk.json"))
+        assert nk.node_id == nk2.node_id
+
+
+class TestPeerManager:
+    def test_address_book_and_dialing(self):
+        pm = PeerManager("a" * 40)
+        addr = PeerAddress("b" * 40, "127.0.0.1:1234")
+        assert pm.add_address(addr)
+        assert not pm.add_address(addr)  # no new info
+        cand = pm.dial_next()
+        assert cand is not None and cand.node_id == "b" * 40
+        assert pm.dial_next() is None  # already dialing
+        pm.dialed(cand)
+        assert pm.connected_peers() == ["b" * 40]
+
+    def test_dial_failure_backoff(self):
+        t = {"now": 0.0}
+        pm = PeerManager("a" * 40, now=lambda: t["now"])
+        pm.add_address(PeerAddress("b" * 40, "127.0.0.1:1"))
+        cand = pm.dial_next()
+        pm.dial_failed(cand)
+        assert pm.dial_next() is None  # in backoff
+        t["now"] = 100.0
+        assert pm.dial_next() is not None
+
+    def test_accepted_capacity(self):
+        pm = PeerManager("a" * 40, max_connected=1)
+        pm.accepted("b" * 40)
+        with pytest.raises(ValueError, match="maximum"):
+            pm.accepted("c" * 40)
+        with pytest.raises(ValueError, match="already"):
+            pm.accepted("b" * 40)
+
+    def test_self_rejected(self):
+        pm = PeerManager("a" * 40)
+        assert not pm.add_address(PeerAddress("a" * 40, "127.0.0.1:1"))
+        with pytest.raises(ValueError, match="self"):
+            pm.accepted("a" * 40)
+
+    def test_subscriptions(self):
+        pm = PeerManager("a" * 40)
+        updates = []
+        pm.subscribe(updates.append)
+        pm.accepted("b" * 40)
+        pm.ready("b" * 40)
+        pm.disconnected("b" * 40)
+        assert [(u.node_id, u.status) for u in updates] == [
+            ("b" * 40, "up"),
+            ("b" * 40, "down"),
+        ]
+
+    def test_persistence(self):
+        from tendermint_tpu.storage import MemDB
+
+        db = MemDB()
+        pm = PeerManager("a" * 40, db=db)
+        pm.add_address(PeerAddress("b" * 40, "1.2.3.4:5"), persistent=True)
+        pm2 = PeerManager("a" * 40, db=db)
+        assert pm2.addresses("b" * 40) == ["1.2.3.4:5"]
+
+
+def make_router(network, name, chain=CHAIN):
+    nk = NodeKey.generate()
+    info = NodeInfo(node_id=nk.node_id, network=chain, listen_addr=name)
+    pm = PeerManager(nk.node_id)
+    transport = network.transport(name)
+    router = Router(info, pm, transport)
+    return router, nk, pm
+
+
+class TestRouterMemory:
+    def test_two_nodes_exchange(self):
+        net = MemoryNetwork()
+        r1, nk1, pm1 = make_router(net, "n1")
+        r2, nk2, pm2 = make_router(net, "n2")
+        ch1 = r1.open_channel(0x7F)
+        ch2 = r2.open_channel(0x7F)
+        r1.start()
+        r2.start()
+        try:
+            pm1.add_address(PeerAddress(nk2.node_id, "n2"))
+            deadline = time.monotonic() + 5
+            while not r1.connected_peers() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert r1.connected_peers() == [nk2.node_id]
+            ch1.broadcast(b"ping")
+            env = ch2.receive(timeout=5)
+            assert env is not None and env.message == b"ping"
+            assert env.from_peer == nk1.node_id
+            ch2.send(Envelope(0x7F, b"pong", to_peer=nk1.node_id))
+            env = ch1.receive(timeout=5)
+            assert env is not None and env.message == b"pong"
+        finally:
+            r1.stop()
+            r2.stop()
+
+    def test_network_mismatch_rejected(self):
+        net = MemoryNetwork()
+        r1, nk1, pm1 = make_router(net, "n1", chain="chain-A")
+        r2, nk2, pm2 = make_router(net, "n2", chain="chain-B")
+        r1.start()
+        r2.start()
+        try:
+            pm1.add_address(PeerAddress(nk2.node_id, "n2"))
+            time.sleep(0.5)
+            assert r1.connected_peers() == []
+        finally:
+            r1.stop()
+            r2.stop()
+
+
+class TestRouterTCP:
+    def test_encrypted_tcp_exchange(self):
+        nk1, nk2 = NodeKey.generate(), NodeKey.generate()
+        t1, t2 = TCPTransport(nk1), TCPTransport(nk2)
+        t2.listen("127.0.0.1:0")
+        info1 = NodeInfo(node_id=nk1.node_id, network=CHAIN)
+        info2 = NodeInfo(node_id=nk2.node_id, network=CHAIN)
+        pm1, pm2 = PeerManager(nk1.node_id), PeerManager(nk2.node_id)
+        r1 = Router(info1, pm1, t1)
+        r2 = Router(info2, pm2, t2)
+        ch1 = r1.open_channel(0x42)
+        ch2 = r2.open_channel(0x42)
+        r1.start()
+        r2.start()
+        try:
+            pm1.add_address(PeerAddress(nk2.node_id, t2.listen_addr))
+            deadline = time.monotonic() + 5
+            while not r1.connected_peers() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert nk2.node_id in r1.connected_peers()
+            ch1.broadcast(b"secret ping over tcp")
+            env = ch2.receive(timeout=5)
+            assert env is not None and env.message == b"secret ping over tcp"
+        finally:
+            r1.stop()
+            r2.stop()
