@@ -1,0 +1,11 @@
+#!/bin/bash
+# Probe the axon TPU tunnel until it answers; log timestamps.
+for i in $(seq 1 60); do
+  if timeout 90 python -u -c "import jax; print(jax.devices())" >/tmp/tpu_probe.log 2>&1; then
+    echo "$(date +%T) TPU BACK after attempt $i" >> /tmp/tpu_probe.log
+    exit 0
+  fi
+  echo "$(date +%T) attempt $i failed" >> /tmp/tpu_probe.log
+  sleep 120
+done
+exit 1

@@ -1,0 +1,193 @@
+"""Batched twisted-Edwards curve ops for ed25519 on TPU, f32 engine.
+
+Points are tuples ``(X, Y, Z, T)`` of :mod:`field32` batches (extended
+coordinates, x = X/Z, y = Y/Z, T = XY/Z). The addition law is the
+unified a=-1 formula, COMPLETE for every pair of curve points (a = -1
+is a square mod p and d/a is a non-square), so the small-order and
+mixed-order inputs that ZIP-215 must accept (reference:
+crypto/ed25519/ed25519.go:24-31) need no special-casing.
+
+Every point op batches its independent field multiplies through ONE
+wide :func:`field32.fe_mul` call by concatenating the operands along
+the lane axis — 2 stacked multiplies per add/double instead of 7-9
+scalar ones. This shrinks the traced graph ~4x (compile time) and
+widens each VPU op 4x.
+
+Two precomputed-operand forms are used (curve25519 folklore):
+
+- *Niels* ``(Y+X, Y-X, 2dT)`` with implied Z=1 for the constant
+  basepoint table (7-mul mixed add);
+- *cached* ``(Y+X, Y-X, Z, 2dT)`` for the per-lane table (8-mul add —
+  the 2dT pre-scale moves the 2d multiply out of the window loop).
+
+Decompression implements the liberal ZIP-215 variant: y >= p encodings
+are accepted; the x == 0 && sign == 1 rejection is kept
+(RFC 8032 5.1.3).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from tendermint_tpu.ops.field32 import (
+    _2P_LIMBS,
+    _P_LIMBS,
+    _ge_const,
+    D2_FE,
+    D_FE,
+    P2_FE,
+    P_FE,
+    SQRT_M1_FE,
+    fe_add,
+    fe_eq,
+    fe_is_zero,
+    fe_mul,
+    fe_mul_const,
+    fe_neg,
+    fe_one,
+    fe_pow22523,
+    fe_reduce_full,
+    fe_select,
+    fe_sq,
+    fe_sub,
+    fe_tight,
+    fe_zero,
+)
+
+Point = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]
+# (Y+X, Y-X, 2dT) with implied Z=1.
+NielsPoint = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
+# (Y+X, Y-X, Z, 2dT).
+CachedPoint = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]
+
+
+def _mul_many(xs: Sequence[jnp.ndarray], ys: Sequence[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Elementwise products of k operand pairs via one lane-stacked fe_mul."""
+    k = len(xs)
+    n = xs[0].shape[1]
+    m = fe_mul(jnp.concatenate(xs, axis=1), jnp.concatenate(ys, axis=1))
+    return [m[:, i * n : (i + 1) * n] for i in range(k)]
+
+
+def pt_identity(n: int) -> Point:
+    return (fe_zero(n), fe_one(n), fe_one(n), fe_zero(n))
+
+
+def pt_neg(p: Point) -> Point:
+    x, y, z, t = p
+    return (fe_neg(x), y, z, fe_neg(t))
+
+
+def pt_to_cached(p: Point) -> CachedPoint:
+    x, y, z, t = p
+    return (fe_add(y, x), fe_sub(y, x), z, fe_mul_const(t, D2_FE))
+
+
+def pt_add_cached(p: Point, q: CachedPoint) -> Point:
+    """Unified a=-1 addition against a cached operand (add-2008-hwcd-3
+    with the 2dT pre-scale folded into q). 2 stacked fe_mul calls."""
+    x1, y1, z1, t1 = p
+    yplusx, yminusx, z2, td2 = q
+    a, b, c, d = _mul_many(
+        [fe_sub(y1, x1), fe_add(y1, x1), t1, z1],
+        [yminusx, yplusx, td2, z2],
+    )
+    d2 = fe_add(d, d)
+    e = fe_sub(b, a)
+    f = fe_sub(d2, c)
+    g = fe_add(d2, c)
+    h = fe_add(b, a)
+    x3, y3, z3, t3 = _mul_many([e, g, f, e], [f, h, g, h])
+    return (x3, y3, z3, t3)
+
+
+def pt_add(p: Point, q: Point) -> Point:
+    """General complete addition (builds the cached form on the fly)."""
+    return pt_add_cached(p, pt_to_cached(q))
+
+
+def pt_madd(p: Point, q: NielsPoint) -> Point:
+    """Mixed addition with a precomputed affine Niels point (Z2=1)."""
+    x1, y1, z1, t1 = p
+    yplusx, yminusx, td2 = q
+    a, b, c = _mul_many(
+        [fe_sub(y1, x1), fe_add(y1, x1), t1], [yminusx, yplusx, td2]
+    )
+    d2 = fe_add(z1, z1)
+    e = fe_sub(b, a)
+    f = fe_sub(d2, c)
+    g = fe_add(d2, c)
+    h = fe_add(b, a)
+    x3, y3, z3, t3 = _mul_many([e, g, f, e], [f, h, g, h])
+    return (x3, y3, z3, t3)
+
+
+def pt_double(p: Point) -> Point:
+    """dbl-2008-hwcd, valid for all inputs. 2 stacked fe_mul calls."""
+    x1, y1, z1, _ = p
+    a, b, zz, sxy = _mul_many(
+        [x1, y1, z1, fe_add(x1, y1)], [x1, y1, z1, fe_add(x1, y1)]
+    )
+    c = fe_add(zz, zz)
+    h = fe_add(a, b)
+    e = fe_sub(h, sxy)
+    g = fe_sub(a, b)
+    f = fe_add(c, g)
+    x3, y3, z3, t3 = _mul_many([e, g, f, e], [f, h, g, h])
+    return (x3, y3, z3, t3)
+
+
+def pt_select(cond: jnp.ndarray, p: Point, q: Point) -> Point:
+    """cond: (N,) bool — p where cond else q, coordinate-wise."""
+    return tuple(fe_select(cond, a, b) for a, b in zip(p, q))  # type: ignore
+
+
+def pt_is_identity(p: Point) -> jnp.ndarray:
+    """(N,) bool: X ≡ 0 and Y ≡ Z (projective identity test)."""
+    x, y, z, _ = p
+    return fe_is_zero(x) & fe_is_zero(fe_sub(y, z))
+
+
+def pt_decompress(y: jnp.ndarray, sign: jnp.ndarray) -> Tuple[Point, jnp.ndarray]:
+    """Liberal (ZIP-215) decompression of a batch.
+
+    y: (32, N) f32 limbs of the 255-bit y-coordinate (any value below
+    2^255 — non-canonical encodings are accepted and reduced
+    implicitly); sign: (N,) f32 in {0, 1}.
+    Returns (point, valid) — invalid lanes hold the identity so the
+    downstream arithmetic stays well-defined.
+    """
+    n = y.shape[1]
+    y2 = fe_sq(y)
+    one = fe_one(n)
+    u = fe_sub(y2, one)
+    v = fe_add(fe_mul_const(y2, D_FE), one)
+    v3 = fe_mul(fe_sq(v), v)
+    v7 = fe_mul(fe_sq(v3), v)
+    x = fe_mul(fe_mul(u, v3), fe_pow22523(fe_mul(u, v7)))
+    vx2 = fe_mul(v, fe_sq(x))
+    root1 = fe_eq(vx2, u)
+    root2 = fe_eq(vx2, fe_neg(u))
+    x = fe_select(root2, fe_mul_const(x, SQRT_M1_FE), x)
+    on_curve = root1 | root2
+    # One tight pass serves both the x == 0 test (tight value ≡ 0 mod p
+    # iff in {0, p, 2p}) and the parity of the canonical representative.
+    xt = fe_tight(x)
+    x_is_zero = (
+        jnp.all(xt == 0, axis=0)
+        | jnp.all(xt == jnp.asarray(P_FE), axis=0)
+        | jnp.all(xt == jnp.asarray(P2_FE), axis=0)
+    )
+    valid = on_curve & ~(x_is_zero & (sign == 1))
+    k = _ge_const(xt, _P_LIMBS).astype(jnp.float32) + _ge_const(
+        xt, _2P_LIMBS
+    ).astype(jnp.float32)
+    pv = xt[0] + k
+    parity = pv - 2.0 * jnp.floor(pv * 0.5)
+    wrong_parity = parity != sign
+    x = fe_select(wrong_parity, fe_neg(x), x)
+    pt: Point = (x, y, one, fe_mul(x, y))
+    ident = pt_identity(n)
+    return pt_select(valid, pt, ident), valid

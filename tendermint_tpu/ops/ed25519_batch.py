@@ -1,25 +1,31 @@
-"""Batched Ed25519 ZIP-215 verification on TPU.
+"""Batched Ed25519 ZIP-215 verification on TPU (f32 limb engine).
 
 The device kernel verifies, for each lane i, the cofactored equation
 
     [8]([s_i]B - R_i - [k_i]A_i) == identity
 
-with a shared-doubling (Straus) double-scalar multiplication: 64
-4-bit windows, per-window additions from a constant basepoint table and
-a per-lane table of [0..15](-A_i). All lanes execute the same 64-step
-loop, so the computation is pure SIMD over the batch — the TPU analog
-of the reference's CPU multi-scalar batch verify
+with a shared-doubling (Straus) double-scalar multiplication: 64 4-bit
+windows, per-window additions from a constant Niels basepoint table
+(7-mul mixed adds) and a per-lane table of [0..15](-A_i). All lanes
+execute the same 64-step loop, so the computation is pure SIMD over the
+batch — the TPU analog of the reference's CPU multi-scalar batch verify
 (crypto/ed25519/ed25519.go:198-233, types/validation.go:154).
 
-Host side does what is cheap and sequential: SHA-512 challenge hashing,
-scalar reduction mod L, byte -> limb/window unpacking (vectorized
-numpy), and the s < L canonicity check. The device does all curve
-arithmetic. Compiled kernels are cached per padded batch-size bucket.
+Layout is transfer-minimal: the host uploads only the raw 32-byte
+strings (A, R, S, and the SHA-512 challenge k reduced mod L) as uint8;
+limb conversion, sign-bit stripping, and 4-bit windowing all happen on
+device, where radix 2^8 f32 limbs make a 32-byte string its own limb
+vector (see :mod:`field32`). Host work is the SHA-512 challenge hash
+(batched in the C extension when available), the s < L canonicity
+check (vectorized byte compare), and padding.
+
+Large batches are split into fixed-size chunks whose kernel calls are
+enqueued back-to-back: JAX's async dispatch overlaps each chunk's H2D
+transfer with the previous chunk's compute.
 """
 
 from __future__ import annotations
 
-import hashlib
 from functools import lru_cache
 from typing import List, Optional, Sequence, Tuple
 
@@ -27,96 +33,181 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tendermint_tpu.ops import curve, field
-from tendermint_tpu.ops.tables import B_TABLE
+from tendermint_tpu.crypto.hashing import L, sha512_batch_mod_l
+from tendermint_tpu.ops import curve32 as curve, field32 as field
 
-L = 2**252 + 27742317777372353535851937790883648493
+_L_BYTES_BE = np.frombuffer(L.to_bytes(32, "big"), dtype=np.uint8)
 
 NWINDOWS = 64  # 256 bits / 4
+
+# Chunk size for pipelined dispatch; also the largest compiled kernel.
+CHUNK = 4096
+_BUCKETS = [64, 256, 1024, CHUNK]
+
+
+# --- constant basepoint table (host precompute, Niels form) -----------------
+
+
+def _build_b_niels_table(width: int = 16) -> np.ndarray:
+    """(width, 3, 32) f32: [0..width-1]B as (Y+X, Y-X, 2dT), Z=1."""
+    from tendermint_tpu.crypto import ed25519_ref as ref
+
+    out = np.zeros((width, 3, field.NLIMBS), dtype=np.float32)
+    p_mod = field.P
+
+    def affine(pt):
+        x_, y_, z_, _ = pt
+        zinv = pow(z_, p_mod - 2, p_mod)
+        return (x_ * zinv % p_mod, y_ * zinv % p_mod)
+
+    for i in range(width):
+        if i == 0:
+            x, y = 0, 1
+        else:
+            acc = ref.B_POINT
+            for _ in range(i - 1):
+                acc = ref.pt_add(acc, ref.B_POINT)
+            x, y = affine(acc)
+        out[i, 0] = field.int_to_limbs((y + x) % p_mod)
+        out[i, 1] = field.int_to_limbs((y - x) % p_mod)
+        out[i, 2] = field.int_to_limbs(2 * field.D * x * y % p_mod)
+    return out
+
+
+B_NIELS = _build_b_niels_table()
 
 
 # --- device kernel ----------------------------------------------------------
 
 
-def _select_from_const_table(digit: jnp.ndarray, table: jnp.ndarray) -> curve.Point:
-    """digit: (N,) int32 in [0,16); table: (16, 4, 20, 1) constant.
-    Constant-time one-hot selection (no gather: stays on the VPU)."""
-    onehot = (jnp.arange(16, dtype=jnp.int32)[:, None] == digit[None, :]).astype(
-        jnp.int32
-    )  # (16, N)
-    sel = jnp.einsum("tn,tcl->cln", onehot, table[:, :, :, 0])
-    return (sel[0], sel[1], sel[2], sel[3])
+def _bytes_to_fe(raw: jnp.ndarray) -> jnp.ndarray:
+    """(N, 32) uint8 -> (32, N) f32 limbs (radix 2^8 == raw bytes)."""
+    return raw.astype(jnp.float32).T
 
 
-def _select_from_lane_table(digit: jnp.ndarray, table: jnp.ndarray) -> curve.Point:
-    """digit: (N,); table: (16, 4, 20, N) per-lane table."""
-    onehot = (jnp.arange(16, dtype=jnp.int32)[:, None] == digit[None, :]).astype(
-        jnp.int32
-    )
+def _strip_sign(y: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(32, N) limbs with bit 255 set-or-not -> (limbs, sign (N,))."""
+    sign = jnp.floor(y[31] * (1.0 / 128.0))
+    y = y.at[31].add(-128.0 * sign)
+    return y, sign
+
+
+def _to_windows(raw: jnp.ndarray) -> jnp.ndarray:
+    """(N, 32) uint8 scalars (LE) -> (64, N) f32 4-bit digits, MSB first."""
+    b = raw.astype(jnp.float32).T  # (32, N)
+    hi = jnp.floor(b * (1.0 / 16.0))
+    lo = b - 16.0 * hi
+    # MSB-first interleave: hi[31], lo[31], hi[30], ...
+    return jnp.stack([hi[::-1], lo[::-1]], axis=1).reshape(2 * field.NLIMBS, -1)
+
+
+def _select_b_niels(digit: jnp.ndarray, table: jnp.ndarray) -> curve.NielsPoint:
+    """digit: (N,) f32 in [0,16); table: (16, 3, 32) const -> Niels point."""
+    onehot = (
+        jnp.arange(16, dtype=jnp.float32)[:, None] == digit[None, :]
+    ).astype(jnp.float32)  # (16, N)
+    sel = jnp.einsum("tn,tcl->cln", onehot, table)
+    return (sel[0], sel[1], sel[2])
+
+
+def _select_lane_cached(digit: jnp.ndarray, table: jnp.ndarray) -> curve.CachedPoint:
+    """digit: (N,); table: (16, 4, 32, N) cached-form per-lane table."""
+    onehot = (
+        jnp.arange(16, dtype=jnp.float32)[:, None] == digit[None, :]
+    ).astype(jnp.float32)
     sel = (onehot[:, None, None, :] * table).sum(axis=0)
     return (sel[0], sel[1], sel[2], sel[3])
 
 
 def _build_lane_table(p: curve.Point) -> jnp.ndarray:
-    """(16, 4, 20, N): [0..15]p via chained complete additions (lax.scan
-    keeps the traced graph to a single pt_add)."""
+    """(16, 4, 32, N) cached-form table of [0..15]p.
+
+    Chained complete additions build the extended multiples (lax.scan
+    keeps the traced graph to one pt_add); the conversion to cached form
+    (Y+X, Y-X, Z, 2dT) batches the 2d pre-scale of all 16 entries into a
+    single wide multiply so the window loop's adds need none.
+    """
     n = p[0].shape[1]
+    cached_p = curve.pt_to_cached(p)
     p_stacked = jnp.stack(p)
 
     def step(acc, _):
         nxt = jnp.stack(
-            curve.pt_add((acc[0], acc[1], acc[2], acc[3]), p)
+            curve.pt_add_cached((acc[0], acc[1], acc[2], acc[3]), cached_p)
         )
         return nxt, nxt
 
     _, rows = jax.lax.scan(step, p_stacked, None, length=14)
-    return jnp.concatenate(
+    ext = jnp.concatenate(
         [jnp.stack(curve.pt_identity(n))[None], p_stacked[None], rows], axis=0
-    )
+    )  # (16, 4, 32, N) extended
+    x, y, z, t = ext[:, 0], ext[:, 1], ext[:, 2], ext[:, 3]
+    # one wide 2d*T multiply across all 16 entries (lanes folded in)
+    t_flat = t.transpose(1, 0, 2).reshape(field.NLIMBS, 16 * n)
+    td2 = field.fe_mul_const(t_flat, field.D2_FE).reshape(field.NLIMBS, 16, n)
+    td2 = td2.transpose(1, 0, 2)
+    yplusx = field.fe_add(
+        y.transpose(1, 0, 2).reshape(field.NLIMBS, 16 * n),
+        x.transpose(1, 0, 2).reshape(field.NLIMBS, 16 * n),
+    ).reshape(field.NLIMBS, 16, n).transpose(1, 0, 2)
+    yminusx = field.fe_sub(
+        y.transpose(1, 0, 2).reshape(field.NLIMBS, 16 * n),
+        x.transpose(1, 0, 2).reshape(field.NLIMBS, 16 * n),
+    ).reshape(field.NLIMBS, 16, n).transpose(1, 0, 2)
+    return jnp.stack([yplusx, yminusx, z, td2], axis=1)
 
 
 def verify_kernel(
-    a_y: jnp.ndarray,
-    a_sign: jnp.ndarray,
-    r_y: jnp.ndarray,
-    r_sign: jnp.ndarray,
-    s_win: jnp.ndarray,
-    k_win: jnp.ndarray,
+    pk_bytes: jnp.ndarray,
+    r_bytes: jnp.ndarray,
+    s_bytes: jnp.ndarray,
+    k_bytes: jnp.ndarray,
 ) -> jnp.ndarray:
-    """(20,N),(N,),(20,N),(N,),(64,N),(64,N) -> (N,) bool."""
-    # Decompress A and R as one 2N batch: halves the decompression HLO and
-    # doubles its SIMD width.
+    """(N,32)x4 uint8 -> (N,) bool."""
+    a_y, a_sign = _strip_sign(_bytes_to_fe(pk_bytes))
+    r_y, r_sign = _strip_sign(_bytes_to_fe(r_bytes))
+    s_win = _to_windows(s_bytes)
+    k_win = _to_windows(k_bytes)
+
+    # Decompress A and R as one 2N batch: halves the decompression HLO
+    # and doubles its SIMD width.
+    nn = a_y.shape[1]
     both_pt, both_ok = curve.pt_decompress(
         jnp.concatenate([a_y, r_y], axis=1),
         jnp.concatenate([a_sign, r_sign], axis=0),
     )
-    nn = a_y.shape[1]
     a_pt = tuple(c[:, :nn] for c in both_pt)
     r_pt = tuple(c[:, nn:] for c in both_pt)
     a_ok, r_ok = both_ok[:nn], both_ok[nn:]
+
     neg_a = curve.pt_neg(a_pt)
     a_table = _build_lane_table(neg_a)
-    b_table = jnp.asarray(B_TABLE)
+    b_table = jnp.asarray(B_NIELS)
 
-    n = a_y.shape[1]
-    init = tuple(jnp.stack(curve.pt_identity(n)))
+    init = jnp.stack(curve.pt_identity(nn))
+
+    def dbl(_, acc_stacked):
+        return jnp.stack(
+            curve.pt_double(
+                (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
+            )
+        )
 
     def body(i, acc_stacked):
+        acc_stacked = jax.lax.fori_loop(0, 4, dbl, acc_stacked)
         acc = (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
-        for _ in range(4):
-            acc = curve.pt_double(acc)
         sd = jax.lax.dynamic_index_in_dim(s_win, i, keepdims=False)
         kd = jax.lax.dynamic_index_in_dim(k_win, i, keepdims=False)
-        acc = curve.pt_add(acc, _select_from_const_table(sd, b_table))
-        acc = curve.pt_add(acc, _select_from_lane_table(kd, a_table))
+        acc = curve.pt_madd(acc, _select_b_niels(sd, b_table))
+        acc = curve.pt_add_cached(acc, _select_lane_cached(kd, a_table))
         return jnp.stack(acc)
 
-    acc_stacked = jax.lax.fori_loop(0, NWINDOWS, body, jnp.stack(init))
+    acc_stacked = jax.lax.fori_loop(0, NWINDOWS, body, init)
     acc = (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
     # [s]B - [k]A computed; subtract R, multiply by cofactor 8, test identity.
     acc = curve.pt_add(acc, curve.pt_neg(r_pt))
-    for _ in range(3):
-        acc = curve.pt_double(acc)
+    acc_stacked = jax.lax.fori_loop(0, 3, dbl, jnp.stack(acc))
+    acc = (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
     return curve.pt_is_identity(acc) & a_ok & r_ok
 
 
@@ -127,7 +218,9 @@ def _enable_persistent_cache() -> None:
 
     cache_dir = os.environ.get(
         "TENDERMINT_TPU_JAX_CACHE",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), ".jax_cache"),
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(__file__))), ".jax_cache"
+        ),
     )
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
@@ -147,45 +240,14 @@ def _compiled_kernel(n: int, backend: Optional[str]):
 
 # --- host-side preparation --------------------------------------------------
 
-_BIT_WEIGHTS = (1 << np.arange(field.RADIX_BITS, dtype=np.int64)).astype(np.int32)
-
-
-def _bytes_to_y_sign(raw: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """(N, 32) uint8 little-endian encodings -> ((20, N) y limbs, (N,) sign).
-
-    The y value is NOT reduced mod p: ZIP-215 liberal decompression
-    accepts y in [p, 2^255) and every device op treats limbs as a loosely
-    reduced representative, so bit-slicing is sufficient.
-    """
-    bits = np.unpackbits(raw, axis=1, bitorder="little")  # (N, 256)
-    sign = bits[:, 255].astype(np.int32)
-    ybits = bits[:, :255]
-    limbs = np.zeros((field.NLIMBS, raw.shape[0]), dtype=np.int32)
-    for i in range(field.NLIMBS):
-        chunk = ybits[:, i * 13 : (i + 1) * 13]  # last limb: 8 bits
-        limbs[i] = chunk.astype(np.int32) @ _BIT_WEIGHTS[: chunk.shape[1]]
-    return limbs, sign
-
-
-def _scalars_to_windows(raw: np.ndarray) -> np.ndarray:
-    """(N, 32) uint8 little-endian scalars -> (64, N) 4-bit digits,
-    most-significant window first (matches the MSB-first Straus loop)."""
-    lo = (raw & 0x0F).astype(np.int32)
-    hi = (raw >> 4).astype(np.int32)
-    digits = np.empty((raw.shape[0], 64), dtype=np.int32)
-    digits[:, 0::2] = lo
-    digits[:, 1::2] = hi
-    return digits[:, ::-1].T.copy()  # MSB window first, (64, N)
-
-
-_BUCKETS = [8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192]
-
 
 def _bucket(n: int) -> int:
+    """Padded size for n lanes: next bucket, or the next CHUNK multiple
+    above CHUNK (large batches are dispatched CHUNK at a time)."""
     for b in _BUCKETS:
         if n <= b:
             return b
-    return ((n + 8191) // 8192) * 8192
+    return ((n + CHUNK - 1) // CHUNK) * CHUNK
 
 
 # A known-good padding triple so padded lanes verify true and never mask
@@ -199,6 +261,28 @@ def _make_pad_entry() -> Tuple[bytes, bytes, bytes]:
 
 
 _PAD_PK, _PAD_MSG, _PAD_SIG = _make_pad_entry()
+_PAD_K: Optional[bytes] = None
+
+
+def _pad_k() -> bytes:
+    global _PAD_K
+    if _PAD_K is None:
+        _PAD_K = sha512_batch_mod_l(
+            [_PAD_SIG[:32] + _PAD_PK + _PAD_MSG]
+        )[0]
+    return _PAD_K
+
+
+def _s_canonical(s_arr: np.ndarray) -> np.ndarray:
+    """(N, 32) little-endian s -> (N,) bool s < L, no Python loop."""
+    s_be = s_arr[:, ::-1].astype(np.int16)
+    diff = s_be - _L_BYTES_BE.astype(np.int16)[None, :]
+    nz = diff != 0
+    first = np.argmax(nz, axis=1)
+    rows = np.arange(s_arr.shape[0])
+    val = diff[rows, first]
+    any_nz = nz.any(axis=1)
+    return np.where(any_nz, val < 0, False)  # s == L is non-canonical
 
 
 def prepare_batch(
@@ -207,54 +291,45 @@ def prepare_batch(
     sigs: Sequence[bytes],
     pad_to: Optional[int] = None,
 ) -> Tuple[dict, np.ndarray]:
-    """Host prep: hash challenges, unpack limbs/windows, pad to bucket.
+    """Host prep: batch-hash challenges, stack raw bytes, pad to bucket.
 
-    Returns (device inputs dict, host_ok (N,) bool of structural checks:
-    lengths and s < L canonicity)."""
+    Returns (device inputs dict of (M,32) uint8 arrays, host_ok (N,)
+    bool of structural checks: lengths and s < L canonicity)."""
     n = len(pubkeys)
     host_ok = np.ones(n, dtype=bool)
     pk_arr = np.zeros((n, 32), dtype=np.uint8)
     r_arr = np.zeros((n, 32), dtype=np.uint8)
     s_arr = np.zeros((n, 32), dtype=np.uint8)
-    k_arr = np.zeros((n, 32), dtype=np.uint8)
+
+    hash_inputs: List[bytes] = []
+    hash_rows: List[int] = []
     for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
         if len(pk) != 32 or len(sig) != 64:
             host_ok[i] = False
             continue
-        s = int.from_bytes(sig[32:], "little")
-        if s >= L:  # non-canonical s: reject (ZIP-215 keeps this check)
-            host_ok[i] = False
-            continue
-        k = int.from_bytes(hashlib.sha512(sig[:32] + pk + msg).digest(), "little") % L
         pk_arr[i] = np.frombuffer(pk, dtype=np.uint8)
         r_arr[i] = np.frombuffer(sig[:32], dtype=np.uint8)
         s_arr[i] = np.frombuffer(sig[32:], dtype=np.uint8)
-        k_arr[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
+        hash_inputs.append(sig[:32] + pk + msg)
+        hash_rows.append(i)
+
+    host_ok &= _s_canonical(s_arr)
+
+    k_arr = np.zeros((n, 32), dtype=np.uint8)
+    if hash_inputs:
+        k_list = sha512_batch_mod_l(hash_inputs)
+        rows = np.asarray(hash_rows)
+        k_arr[rows] = np.frombuffer(b"".join(k_list), dtype=np.uint8).reshape(-1, 32)
 
     m = pad_to if pad_to is not None else _bucket(n)
     if m > n:
-        pad_pk = np.frombuffer(_PAD_PK, dtype=np.uint8)
-        pad_r = np.frombuffer(_PAD_SIG[:32], dtype=np.uint8)
-        pad_s = np.frombuffer(_PAD_SIG[32:], dtype=np.uint8)
-        pad_k = int.from_bytes(
-            hashlib.sha512(_PAD_SIG[:32] + _PAD_PK + _PAD_MSG).digest(), "little"
-        ) % L
-        pad_kb = np.frombuffer(pad_k.to_bytes(32, "little"), dtype=np.uint8)
-        pk_arr = np.concatenate([pk_arr, np.tile(pad_pk, (m - n, 1))])
-        r_arr = np.concatenate([r_arr, np.tile(pad_r, (m - n, 1))])
-        s_arr = np.concatenate([s_arr, np.tile(pad_s, (m - n, 1))])
-        k_arr = np.concatenate([k_arr, np.tile(pad_kb, (m - n, 1))])
+        pad = np.zeros((m - n, 32), dtype=np.uint8)
+        pk_arr = np.concatenate([pk_arr, pad + np.frombuffer(_PAD_PK, dtype=np.uint8)])
+        r_arr = np.concatenate([r_arr, pad + np.frombuffer(_PAD_SIG[:32], dtype=np.uint8)])
+        s_arr = np.concatenate([s_arr, pad + np.frombuffer(_PAD_SIG[32:], dtype=np.uint8)])
+        k_arr = np.concatenate([k_arr, pad + np.frombuffer(_pad_k(), dtype=np.uint8)])
 
-    a_y, a_sign = _bytes_to_y_sign(pk_arr)
-    r_y, r_sign = _bytes_to_y_sign(r_arr)
-    inputs = dict(
-        a_y=a_y,
-        a_sign=a_sign,
-        r_y=r_y,
-        r_sign=r_sign,
-        s_win=_scalars_to_windows(s_arr),
-        k_win=_scalars_to_windows(k_arr),
-    )
+    inputs = dict(pk=pk_arr, r=r_arr, s=s_arr, k=k_arr)
     return inputs, host_ok
 
 
@@ -268,20 +343,27 @@ def verify_batch(
 
     The entry point behind crypto.Ed25519BatchVerifier — reference
     contract crypto/crypto.go:58-76 / crypto/ed25519/ed25519.go:198-233.
+
+    Batches larger than CHUNK are split and their kernel calls enqueued
+    back-to-back so H2D transfer of chunk j+1 overlaps compute of
+    chunk j (JAX async dispatch).
     """
     n = len(pubkeys)
     if n == 0:
         return []
-    inputs, host_ok = prepare_batch(pubkeys, msgs, sigs)
-    fn = _compiled_kernel(inputs["a_y"].shape[1], backend)
-    device_ok = np.asarray(
-        fn(
-            jnp.asarray(inputs["a_y"]),
-            jnp.asarray(inputs["a_sign"]),
-            jnp.asarray(inputs["r_y"]),
-            jnp.asarray(inputs["r_sign"]),
-            jnp.asarray(inputs["s_win"]),
-            jnp.asarray(inputs["k_win"]),
+    inputs, host_ok = prepare_batch(pubkeys, msgs, sigs, pad_to=_bucket(n))
+    m = inputs["pk"].shape[0]
+    outs = []
+    for lo in range(0, m, CHUNK):
+        hi = min(lo + CHUNK, m)
+        fn = _compiled_kernel(hi - lo, backend)
+        outs.append(
+            fn(
+                jnp.asarray(inputs["pk"][lo:hi]),
+                jnp.asarray(inputs["r"][lo:hi]),
+                jnp.asarray(inputs["s"][lo:hi]),
+                jnp.asarray(inputs["k"][lo:hi]),
+            )
         )
-    )[:n]
+    device_ok = np.concatenate([np.asarray(o) for o in outs])[:n]
     return list(np.logical_and(device_ok, host_ok))

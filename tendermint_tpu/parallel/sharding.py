@@ -40,13 +40,12 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
 
 @lru_cache(maxsize=8)
 def _sharded_fn_for_mesh(mesh: Mesh):
-    # Shardings by rank: (20, N) and (64, N) shard the trailing lane axis;
-    # (N,) shards its only axis.
-    lane2 = NamedSharding(mesh, P(None, SIG_AXIS))
+    # Kernel inputs are (N, 32) uint8 raw-byte arrays: lanes on axis 0.
+    rows = NamedSharding(mesh, P(SIG_AXIS, None))
     lane1 = NamedSharding(mesh, P(SIG_AXIS))
     return jax.jit(
         ed25519_batch.verify_kernel,
-        in_shardings=(lane2, lane1, lane2, lane1, lane2, lane2),
+        in_shardings=(rows, rows, rows, rows),
         out_shardings=lane1,
     )
 
@@ -80,13 +79,6 @@ def verify_batch_sharded(
     inputs, host_ok = ed25519_batch.prepare_batch(pubkeys, msgs, sigs, pad_to=pad_to)
     fn = _sharded_fn_for_mesh(mesh)
     device_ok = np.asarray(
-        fn(
-            inputs["a_y"],
-            inputs["a_sign"],
-            inputs["r_y"],
-            inputs["r_sign"],
-            inputs["s_win"],
-            inputs["k_win"],
-        )
+        fn(inputs["pk"], inputs["r"], inputs["s"], inputs["k"])
     )[:n]
     return list(np.logical_and(device_ok, host_ok))

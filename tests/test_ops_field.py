@@ -1,4 +1,10 @@
-"""Field arithmetic vs Python-int ground truth (runs eagerly on CPU)."""
+"""f32 field arithmetic vs Python-int ground truth (runs eagerly on CPU).
+
+The engine's exactness argument (field32.py module docstring) is that
+every intermediate stays below 2^24 in magnitude; these tests check the
+resulting values against arbitrary-precision ints, including edge and
+adversarial inputs at the loose-invariant boundary.
+"""
 
 import random
 
@@ -6,12 +12,12 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
-from tendermint_tpu.ops import field
+from tendermint_tpu.ops import field32 as field
 
 
 def to_arr(vals):
     return jnp.asarray(
-        np.array([field.int_to_limbs(v) for v in vals], dtype=np.int32).T
+        np.array([field.int_to_limbs(v) for v in vals], dtype=np.float32).T
     )
 
 
@@ -34,6 +40,17 @@ def test_mul_add_sub_vs_ints(rng):
         assert field.limbs_to_int(sub[:, i]) == (xs[i] - ys[i]) % field.P
 
 
+def test_mul_at_loose_bound():
+    # Inputs with every limb at the loose-invariant max (~2^9-1): the
+    # worst case for f32 column exactness.
+    worst = jnp.full((field.NLIMBS, 4), 511.0, dtype=jnp.float32)
+    val = sum(511 << (8 * i) for i in range(field.NLIMBS))
+    got = np.asarray(field.fe_mul(worst, worst))
+    assert field.limbs_to_int(got[:, 0]) == val * val % field.P
+    got2 = np.asarray(field.fe_carry(worst))
+    assert field.limbs_to_int(got2[:, 0]) == val % field.P
+
+
 def test_edge_values():
     xs = [0, 1, 2, field.P - 1, field.P, field.P + 1, 2**255 - 1, 19, 2**255 - 19]
     X = to_arr(xs)
@@ -43,7 +60,7 @@ def test_edge_values():
         assert field.limbs_to_int(sq[:, i]) == x * x % field.P
         got = field.limbs_to_int(red[:, i])
         assert got == x % field.P
-        assert all(0 <= v < 8192 for v in red[:, i])
+        assert all(0 <= v < 256 for v in red[:, i])
 
 
 def test_is_zero_and_eq():
@@ -65,11 +82,25 @@ def test_pow22523(rng):
 def test_carry_handles_large_and_negative():
     # raw limbs outside the invariant (e.g. from subtraction paths)
     raw = jnp.asarray(
-        np.array([[10_000_000] + [0] * 19, [-5] + [3] * 19], dtype=np.int32).T
+        np.array(
+            [[4_000_000.0] + [0.0] * 31, [-5.0] + [3.0] * 31], dtype=np.float32
+        ).T
     )
     out = np.asarray(field.fe_carry(raw))
-    want0 = 10_000_000 % field.P
-    got0 = field.limbs_to_int(out[:, 0])
-    assert got0 == want0
-    want1 = (-5 + sum(3 << (13 * i) for i in range(1, 20))) % field.P
+    assert field.limbs_to_int(out[:, 0]) == 4_000_000 % field.P
+    want1 = (-5 + sum(3 << (8 * i) for i in range(1, 32))) % field.P
     assert field.limbs_to_int(out[:, 1]) == want1
+
+
+def test_chained_ops_stay_exact(rng):
+    # Long dependent chains never leave the exact-f32 envelope.
+    xs = [rng.randrange(2**255) for _ in range(4)]
+    ys = [rng.randrange(2**255) for _ in range(4)]
+    X, Y = to_arr(xs), to_arr(ys)
+    want = [(x, y) for x, y in zip(xs, ys)]
+    for step in range(20):
+        X, Y = field.fe_mul(X, Y), field.fe_sub(field.fe_add(X, Y), X)
+        want = [(x * y % field.P, y) for x, y in want]
+    got = np.asarray(X)
+    for i in range(4):
+        assert field.limbs_to_int(got[:, i]) == want[i][0]
