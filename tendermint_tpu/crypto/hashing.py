@@ -64,6 +64,14 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint8),
         ]
         lib.sha512_batch.restype = None
+        lib.sha512_batch_prefixed.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.sha512_batch_prefixed.restype = None
         return lib
     except Exception:
         return None
@@ -95,6 +103,43 @@ def sha512_batch(msgs: Sequence[bytes]) -> np.ndarray:
         buf = np.zeros(1, dtype=np.uint8)
     out = np.empty((n, 64), dtype=np.uint8)
     lib.sha512_batch(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out
+
+
+def sha512_batch_prefixed(prefix: np.ndarray, msgs: Sequence[bytes]) -> np.ndarray:
+    """Hash prefix_i || msg_i for a (N, 64) uint8 prefix block -> (N, 64).
+
+    The verifier's challenge is SHA-512(R || A || M); R and A already
+    live in (N, 32) arrays, so the 64-byte prefix block costs one
+    concatenate instead of N Python byte-string builds.
+    """
+    n = len(msgs)
+    assert prefix.shape == (n, 64) and prefix.dtype == np.uint8
+    if n == 0:
+        return np.zeros((0, 64), dtype=np.uint8)
+    lib = _lib()
+    if lib is None:
+        out = np.empty((n, 64), dtype=np.uint8)
+        pb = np.ascontiguousarray(prefix)
+        for i, m in enumerate(msgs):
+            h = hashlib.sha512(pb[i].tobytes())
+            h.update(m)
+            out[i] = np.frombuffer(h.digest(), dtype=np.uint8)
+        return out
+    offsets = np.zeros(n + 1, dtype=np.uint64)
+    np.cumsum([len(m) for m in msgs], out=offsets[1:])
+    buf = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+    if buf.size == 0:
+        buf = np.zeros(1, dtype=np.uint8)
+    out = np.empty((n, 64), dtype=np.uint8)
+    pb = np.ascontiguousarray(prefix)
+    lib.sha512_batch_prefixed(
+        pb.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         n,

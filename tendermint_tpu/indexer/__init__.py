@@ -1,0 +1,3 @@
+from tendermint_tpu.indexer.kv import KVIndexer, TxResult
+
+__all__ = ["KVIndexer", "TxResult"]

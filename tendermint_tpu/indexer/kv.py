@@ -1,0 +1,304 @@
+"""KV event sink: tx + block indexing for /tx_search and /block_search.
+
+The reference indexes txs and block events into a KV store behind the
+``EventSink`` interface (internal/state/indexer/sink/kv/kv.go,
+indexer/tx/kv/): tx results keyed by hash, plus composite-key event
+index entries ``<key>/<value>/<height>/<index>`` enabling query-driven
+search. This implementation keeps the same key discipline over the
+storage/kv.py abstraction so any backend (MemDB or persistent) works.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.libs.pubsub import Query
+from tendermint_tpu.storage.kv import KVStore
+
+_TX_HASH_PREFIX = b"tx.hash/"
+_TX_HEIGHT_PREFIX = b"tx.height/"
+_TX_EVENT_PREFIX = b"txevt/"
+_BLOCK_EVENT_PREFIX = b"blkevt/"
+_BLOCK_HEIGHT_KEY = b"blk.height/"
+
+
+@dataclass
+class TxResult:
+    """Indexed transaction (proto abci.TxResult analog)."""
+
+    height: int
+    index: int
+    tx: bytes
+    result: abci.ExecTxResult
+
+    def hash(self) -> bytes:
+        return hashlib.sha256(self.tx).digest()
+
+    def to_json(self) -> bytes:
+        return json.dumps(
+            {
+                "height": self.height,
+                "index": self.index,
+                "tx": self.tx.hex(),
+                "code": self.result.code,
+                "data": self.result.data.hex(),
+                "log": self.result.log,
+                "gas_wanted": self.result.gas_wanted,
+                "gas_used": self.result.gas_used,
+                "events": [
+                    {
+                        "type": e.type,
+                        "attributes": [
+                            {"key": a.key, "value": a.value, "index": a.index}
+                            for a in e.attributes
+                        ],
+                    }
+                    for e in (self.result.events or [])
+                ],
+            }
+        ).encode()
+
+    @staticmethod
+    def from_json(raw: bytes) -> "TxResult":
+        d = json.loads(raw.decode())
+        return TxResult(
+            height=d["height"],
+            index=d["index"],
+            tx=bytes.fromhex(d["tx"]),
+            result=abci.ExecTxResult(
+                code=d["code"],
+                data=bytes.fromhex(d["data"]),
+                log=d["log"],
+                gas_wanted=d["gas_wanted"],
+                gas_used=d["gas_used"],
+                events=[
+                    abci.Event(
+                        type=e["type"],
+                        attributes=[
+                            abci.EventAttribute(
+                                key=a["key"], value=a["value"], index=a["index"]
+                            )
+                            for a in e["attributes"]
+                        ],
+                    )
+                    for e in d["events"]
+                ],
+            ),
+        )
+
+
+def _evt_key(prefix: bytes, key: str, value: str, height: int, index: int) -> bytes:
+    return prefix + (
+        f"{key}/{value}/{height:020d}/{index:010d}".encode()
+    )
+
+
+def _prefix_end(prefix: bytes) -> bytes:
+    """Smallest byte string greater than every key with this prefix."""
+    p = bytearray(prefix)
+    while p and p[-1] == 0xFF:
+        p.pop()
+    if not p:
+        return b"\xff" * (len(prefix) + 1)
+    p[-1] += 1
+    return bytes(p)
+
+
+def _iter_prefix(db: KVStore, prefix: bytes):
+    return db.iterator(prefix, _prefix_end(prefix))
+
+
+def _num_cond_matches(cond, val: float) -> bool:
+    try:
+        bound = float(cond.value)
+    except ValueError:
+        return False
+    return (
+        (cond.op == "=" and val == bound)
+        or (cond.op == "<" and val < bound)
+        or (cond.op == "<=" and val <= bound)
+        or (cond.op == ">" and val > bound)
+        or (cond.op == ">=" and val >= bound)
+    )
+
+
+class KVIndexer:
+    """Tx + block event index over a KV store."""
+
+    def __init__(self, db: KVStore):
+        self.db = db
+
+    # -- indexing -------------------------------------------------------------
+
+    def index_block_events(self, height: int, events: List[abci.Event]) -> None:
+        batch = self.db.new_batch()
+        batch.set(_BLOCK_HEIGHT_KEY + f"{height:020d}".encode(), str(height).encode())
+        for ev in events or []:
+            if not ev.type:
+                continue
+            for attr in ev.attributes or []:
+                if not attr.index:
+                    continue
+                k = _evt_key(
+                    _BLOCK_EVENT_PREFIX, f"{ev.type}.{attr.key}", attr.value, height, 0
+                )
+                batch.set(k, str(height).encode())
+        batch.write()
+
+    def index_txs(self, results: Iterable[TxResult]) -> None:
+        batch = self.db.new_batch()
+        for tr in results:
+            h = tr.hash()
+            batch.set(_TX_HASH_PREFIX + h, tr.to_json())
+            batch.set(
+                _evt_key(_TX_EVENT_PREFIX, "tx.height", str(tr.height), tr.height, tr.index),
+                h,
+            )
+            for ev in tr.result.events or []:
+                if not ev.type:
+                    continue
+                for attr in ev.attributes or []:
+                    if not attr.index:
+                        continue
+                    k = _evt_key(
+                        _TX_EVENT_PREFIX,
+                        f"{ev.type}.{attr.key}",
+                        attr.value,
+                        tr.height,
+                        tr.index,
+                    )
+                    batch.set(k, h)
+        batch.write()
+
+    # -- queries --------------------------------------------------------------
+
+    def get_tx(self, tx_hash: bytes) -> Optional[TxResult]:
+        raw = self.db.get(_TX_HASH_PREFIX + tx_hash)
+        return TxResult.from_json(raw) if raw is not None else None
+
+    def search_txs(self, query: Query, limit: int = 100) -> List[TxResult]:
+        """AND-of-conditions search mirroring tx/kv/kv.go: each condition
+        produces a hash set from its index range; results are the
+        intersection, height/index ordered."""
+        hash_sets: List[set] = []
+        for cond in query.conditions:
+            hashes = set()
+            # tm.event is implicit in this index: every indexed entry IS
+            # a Tx event (reference kv indexer special-cases it, tx/kv).
+            if cond.key == "tm.event":
+                if cond.op == "=" and cond.value != "Tx":
+                    return []
+                continue
+            if cond.key == "tx.hash" and cond.op == "=":
+                try:
+                    h = bytes.fromhex(cond.value)
+                except ValueError:
+                    return []
+                hash_sets.append({h} if self.get_tx(h) is not None else set())
+                continue
+            if cond.op == "=":
+                prefix = _TX_EVENT_PREFIX + f"{cond.key}/{cond.value}/".encode()
+                for _, v in _iter_prefix(self.db, prefix):
+                    hashes.add(bytes(v))
+            elif cond.op in ("<", "<=", ">", ">="):
+                prefix = _TX_EVENT_PREFIX + f"{cond.key}/".encode()
+                bound = float(cond.value)
+                for k, v in _iter_prefix(self.db, prefix):
+                    parts = k[len(prefix) :].rsplit(b"/", 2)
+                    if len(parts) != 3:
+                        continue
+                    try:
+                        val = float(parts[0])
+                    except ValueError:
+                        continue
+                    if (
+                        (cond.op == "<" and val < bound)
+                        or (cond.op == "<=" and val <= bound)
+                        or (cond.op == ">" and val > bound)
+                        or (cond.op == ">=" and val >= bound)
+                    ):
+                        hashes.add(bytes(v))
+            elif cond.op == "CONTAINS":
+                prefix = _TX_EVENT_PREFIX + f"{cond.key}/".encode()
+                for k, v in _iter_prefix(self.db, prefix):
+                    parts = k[len(prefix) :].rsplit(b"/", 2)
+                    if len(parts) == 3 and cond.value.encode() in parts[0]:
+                        hashes.add(bytes(v))
+            elif cond.op == "EXISTS":
+                prefix = _TX_EVENT_PREFIX + f"{cond.key}/".encode()
+                for _, v in _iter_prefix(self.db, prefix):
+                    hashes.add(bytes(v))
+            hash_sets.append(hashes)
+        if not hash_sets:
+            # query was only tm.event = 'Tx': all indexed txs
+            common = set()
+            for _, v in _iter_prefix(self.db, _TX_EVENT_PREFIX + b"tx.height/"):
+                common.add(bytes(v))
+        else:
+            common = set.intersection(*hash_sets)
+        out = []
+        for h in common:
+            tr = self.get_tx(h)
+            if tr is not None:
+                out.append(tr)
+        out.sort(key=lambda t: (t.height, t.index))
+        return out[:limit]
+
+    def search_block_heights(self, query: Query, limit: int = 100) -> List[int]:
+        height_sets: List[set] = []
+        for cond in query.conditions:
+            heights = set()
+            if cond.key == "tm.event":
+                if cond.op == "=" and cond.value != "NewBlock":
+                    return []
+                continue
+            if cond.key == "block.height":
+                prefix = _BLOCK_HEIGHT_KEY
+                for _, v in _iter_prefix(self.db, prefix):
+                    hv = int(v.decode())
+                    if _num_cond_matches(cond, hv):
+                        heights.add(hv)
+                height_sets.append(heights)
+                continue
+            if cond.op == "=":
+                prefix = _BLOCK_EVENT_PREFIX + f"{cond.key}/{cond.value}/".encode()
+                for _, v in _iter_prefix(self.db, prefix):
+                    heights.add(int(v.decode()))
+            else:
+                prefix = _BLOCK_EVENT_PREFIX + f"{cond.key}/".encode()
+                for k, v in _iter_prefix(self.db, prefix):
+                    parts = k[len(prefix) :].rsplit(b"/", 2)
+                    if len(parts) != 3:
+                        continue
+                    sval = parts[0].decode()
+                    if cond.op == "EXISTS":
+                        heights.add(int(v.decode()))
+                        continue
+                    if cond.op == "CONTAINS":
+                        if cond.value in sval:
+                            heights.add(int(v.decode()))
+                        continue
+                    try:
+                        val = float(sval)
+                        bound = float(cond.value)
+                    except ValueError:
+                        continue
+                    if (
+                        (cond.op == "<" and val < bound)
+                        or (cond.op == "<=" and val <= bound)
+                        or (cond.op == ">" and val > bound)
+                        or (cond.op == ">=" and val >= bound)
+                    ):
+                        heights.add(int(v.decode()))
+            height_sets.append(heights)
+        if not height_sets:
+            # query was only tm.event = 'NewBlock': every stored height
+            heights = set()
+            for _, v in _iter_prefix(self.db, _BLOCK_HEIGHT_KEY):
+                heights.add(int(v.decode()))
+            return sorted(heights)[:limit]
+        return sorted(set.intersection(*height_sets))[:limit]

@@ -75,3 +75,64 @@ class MemoryProvider(Provider):
     def report_evidence(self, evidence: Evidence) -> None:
         with self._lock:
             self.evidence.append(evidence)
+
+
+class HTTPProvider(Provider):
+    """RPC-backed provider (light/provider/http/http.go): builds
+    LightBlocks from /commit + /validators against a full node."""
+
+    def __init__(self, chain_id: str, url: str, timeout: float = 10.0):
+        from tendermint_tpu.rpc.client import HTTPClient
+
+        self._chain_id = chain_id
+        self.client = HTTPClient(url, timeout=timeout)
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        from tendermint_tpu.rpc import encoding as enc
+        from tendermint_tpu.rpc.client import RPCClientError
+        from tendermint_tpu.types.light import SignedHeader
+        from tendermint_tpu.types.validator_set import ValidatorSet
+
+        try:
+            c = self.client.commit(height if height > 0 else None)
+            h = int(c["signed_header"]["header"]["height"])
+            v = self.client.validators(h, per_page=100)
+            vals = [enc.validator_from_json(d) for d in v["validators"]]
+            total = int(v["total"])
+            page = 2
+            while len(vals) < total:
+                more = self.client.validators(h, page=page, per_page=100)
+                got = [enc.validator_from_json(d) for d in more["validators"]]
+                if not got:
+                    break
+                vals.extend(got)
+                page += 1
+        except RPCClientError as e:
+            msg = e.message + " " + e.data
+            if "no block" in msg or "no commit" in msg:
+                raise HeightTooHighError(msg)
+            raise LightBlockNotFoundError(msg)
+        except OSError as e:
+            raise ProviderError(str(e))
+        vset = ValidatorSet(vals)
+        # Preserve the proposer priorities the full node reported rather
+        # than recomputing (validators_hash must match the header).
+        return LightBlock(
+            signed_header=SignedHeader(
+                header=enc.header_from_json(c["signed_header"]["header"]),
+                commit=enc.commit_from_json(c["signed_header"]["commit"]),
+            ),
+            validator_set=vset,
+        )
+
+    def report_evidence(self, evidence: Evidence) -> None:
+        try:
+            self.client.call(
+                "broadcast_evidence",
+                {"evidence": "0x" + evidence.to_proto_bytes().hex()},
+            )
+        except Exception:
+            pass

@@ -119,3 +119,76 @@ void sha512_batch(const uint8_t *buf, const uint64_t *offsets, int64_t n,
                out + (uint64_t)i * 64);
   }
 }
+
+/* Streaming variant used by the prefixed batch below. */
+typedef struct {
+  uint64_t h[8];
+  uint8_t buf[128];
+  uint64_t buflen;
+  uint64_t total;
+} sha512_ctx;
+
+static void sha512_init(sha512_ctx *c) {
+  static const uint64_t iv[8] = {
+      0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+      0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+      0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+  memcpy(c->h, iv, sizeof(iv));
+  c->buflen = 0;
+  c->total = 0;
+}
+
+static void sha512_update(sha512_ctx *c, const uint8_t *p, uint64_t len) {
+  c->total += len;
+  if (c->buflen) {
+    uint64_t take = 128 - c->buflen;
+    if (take > len) take = len;
+    memcpy(c->buf + c->buflen, p, take);
+    c->buflen += take;
+    p += take;
+    len -= take;
+    if (c->buflen == 128) {
+      sha512_compress(c->h, c->buf);
+      c->buflen = 0;
+    }
+  }
+  for (; len >= 128; p += 128, len -= 128) sha512_compress(c->h, p);
+  if (len) {
+    memcpy(c->buf, p, len);
+    c->buflen = len;
+  }
+}
+
+static void sha512_final(sha512_ctx *c, uint8_t out[64]) {
+  uint64_t rem = c->buflen;
+  uint8_t tail[256];
+  memcpy(tail, c->buf, rem);
+  tail[rem] = 0x80;
+  uint64_t padlen = (rem < 112) ? 128 : 256;
+  memset(tail + rem + 1, 0, padlen - rem - 1 - 16);
+  memset(tail + padlen - 16, 0, 8);
+  uint64_t bits = c->total * 8;
+  for (int j = 0; j < 8; j++) tail[padlen - 1 - j] = (uint8_t)(bits >> (8 * j));
+  sha512_compress(c->h, tail);
+  if (padlen == 256) sha512_compress(c->h, tail + 128);
+  for (int j = 0; j < 8; j++)
+    for (int b = 0; b < 8; b++)
+      out[j * 8 + b] = (uint8_t)(c->h[j] >> (56 - 8 * b));
+}
+
+/* Hash n messages of the form prefix_i || msg_i where every prefix is a
+ * fixed 64 bytes (the verifier's R || A) laid out contiguously. Saves
+ * the host from materializing n concatenated byte strings. */
+void sha512_batch_prefixed(const uint8_t *prefix, const uint8_t *buf,
+                           const uint64_t *offsets, int64_t n, uint8_t *out) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t i = 0; i < n; i++) {
+    sha512_ctx c;
+    sha512_init(&c);
+    sha512_update(&c, prefix + (uint64_t)i * 64, 64);
+    sha512_update(&c, buf + offsets[i], offsets[i + 1] - offsets[i]);
+    sha512_final(&c, out + (uint64_t)i * 64);
+  }
+}

@@ -16,6 +16,7 @@ from typing import List, Optional
 
 from tendermint_tpu.abci.client import AbciClient, LocalClient
 from tendermint_tpu.abci import types as abci_types
+from tendermint_tpu import eventbus as events_mod
 from tendermint_tpu.blocksync.reactor import BlockSyncReactor
 from tendermint_tpu.blocksync.syncer import BlockSyncer
 from tendermint_tpu.consensus.reactor import ConsensusReactor
@@ -57,6 +58,8 @@ class NodeConfig:
     wal_enabled: bool = True
     max_connections: int = 16
     moniker: str = "tpu-node"
+    rpc_laddr: str = ""  # "host:port" enables the RPC server ("" = off)
+    tx_index: bool = True
 
 
 class Node:
@@ -122,6 +125,19 @@ class Node:
             self.sm_state = stored
             app_client.start()
 
+        # --- event bus + indexer (node.go:158-184) ---------------------------
+        # Indexing is synchronous inside _fire_events rather than via a
+        # pubsub subscription: subscriptions are bounded queues that DROP
+        # under backpressure (fine for RPC subscribers, lossy for an
+        # index). The reference's indexer subscription is lossless /
+        # publisher-blocking for the same reason (indexer_service.go).
+        self.event_bus = events_mod.EventBus()
+        self.indexer = None
+        if config.tx_index:
+            from tendermint_tpu.indexer import KVIndexer
+
+            self.indexer = KVIndexer(MemDB())
+
         # --- pools + executor (node.go:258-297) ------------------------------
         self.mempool = TxMempool(config.mempool, app_client)
         self.evidence_pool = EvidencePool(
@@ -134,6 +150,7 @@ class Node:
             self.block_store,
             mempool=self.mempool,
             evidence_pool=self.evidence_pool,
+            event_publisher=self._fire_events,
         )
 
         # --- p2p (node.go:206-256) -------------------------------------------
@@ -189,6 +206,33 @@ class Node:
             self.syncer, self.block_store, self.router
         )
         self.pex_reactor = PexReactor(self.peer_manager, self.router)
+
+        # --- RPC (node.go:512, internal/rpc/core) ----------------------------
+        self.rpc_server = None
+        if config.rpc_laddr:
+            from tendermint_tpu.rpc.core import Environment
+            from tendermint_tpu.rpc.server import RPCServer
+
+            host, _, port = config.rpc_laddr.rpartition(":")
+            env = Environment(
+                node_info=self.node_info,
+                genesis=self.genesis,
+                block_store=self.block_store,
+                state_store=self.state_store,
+                consensus=self.consensus,
+                mempool=self.mempool,
+                evidence_pool=self.evidence_pool,
+                app_client=self.app,
+                event_bus=self.event_bus,
+                indexer=self.indexer,
+                peer_manager=self.peer_manager,
+                get_state=lambda: self.consensus.state,
+                is_syncing=lambda: not self._caught_up_event.is_set(),
+            )
+            self.rpc_env = env
+            self.rpc_server = RPCServer(
+                env.routes(), host=host or "127.0.0.1", port=int(port)
+            )
         self._started = False
 
     # --- lifecycle -----------------------------------------------------------
@@ -211,6 +255,8 @@ class Node:
             threading.Thread(
                 target=self._blocksync_grace, daemon=True
             ).start()
+        if self.rpc_server is not None:
+            self.rpc_server.start()
         self._started = True
 
     def _blocksync_grace(self) -> None:
@@ -236,6 +282,11 @@ class Node:
         self.consensus.start()
 
     def stop(self) -> None:
+        if self.rpc_server is not None:
+            try:
+                self.rpc_server.stop()
+            except Exception:
+                pass
         try:
             self.consensus.stop()
         except Exception:
@@ -253,6 +304,48 @@ class Node:
                 pass
         self.router.stop()
         self._started = False
+
+    def _fire_events(self, block, block_id, fres, validator_updates) -> None:
+        """execution.go:600-648 fireEvents: publish NewBlock, header, one
+        event per tx, and validator-set updates onto the bus."""
+        if self.indexer is not None:
+            from tendermint_tpu.indexer import TxResult
+
+            self.indexer.index_block_events(block.header.height, fres.events)
+            txs_all = list(block.data.txs)
+            self.indexer.index_txs(
+                TxResult(
+                    height=block.header.height, index=i, tx=txs_all[i], result=r
+                )
+                for i, r in enumerate(fres.tx_results)
+                if i < len(txs_all)
+            )
+        bus = self.event_bus
+        bus.publish_event_new_block(
+            events_mod.EventDataNewBlock(
+                block=block, block_id=block_id, result_finalize_block=fres
+            )
+        )
+        bus.publish_event_new_block_header(
+            events_mod.EventDataNewBlockHeader(
+                header=block.header, num_txs=len(block.data.txs)
+            )
+        )
+        txs = list(block.data.txs)
+        for i, r in enumerate(fres.tx_results):
+            if i >= len(txs):
+                break
+            bus.publish_event_tx(
+                events_mod.EventDataTx(
+                    height=block.header.height, index=i, tx=txs[i], result=r
+                )
+            )
+        if validator_updates:
+            bus.publish_event_validator_set_updates(
+                events_mod.EventDataValidatorSetUpdates(
+                    validator_updates=list(validator_updates)
+                )
+            )
 
     # --- convenience ---------------------------------------------------------
 

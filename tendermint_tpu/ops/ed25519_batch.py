@@ -295,31 +295,43 @@ def prepare_batch(
 
     Returns (device inputs dict of (M,32) uint8 arrays, host_ok (N,)
     bool of structural checks: lengths and s < L canonicity)."""
+    from tendermint_tpu.crypto.hashing import reduce_mod_l, sha512_batch_prefixed
+
     n = len(pubkeys)
-    host_ok = np.ones(n, dtype=bool)
-    pk_arr = np.zeros((n, 32), dtype=np.uint8)
-    r_arr = np.zeros((n, 32), dtype=np.uint8)
-    s_arr = np.zeros((n, 32), dtype=np.uint8)
-
-    hash_inputs: List[bytes] = []
-    hash_rows: List[int] = []
-    for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
-        if len(pk) != 32 or len(sig) != 64:
-            host_ok[i] = False
-            continue
-        pk_arr[i] = np.frombuffer(pk, dtype=np.uint8)
-        r_arr[i] = np.frombuffer(sig[:32], dtype=np.uint8)
-        s_arr[i] = np.frombuffer(sig[32:], dtype=np.uint8)
-        hash_inputs.append(sig[:32] + pk + msg)
-        hash_rows.append(i)
-
-    host_ok &= _s_canonical(s_arr)
-
-    k_arr = np.zeros((n, 32), dtype=np.uint8)
-    if hash_inputs:
-        k_list = sha512_batch_mod_l(hash_inputs)
-        rows = np.asarray(hash_rows)
-        k_arr[rows] = np.frombuffer(b"".join(k_list), dtype=np.uint8).reshape(-1, 32)
+    len_ok = all(len(pk) == 32 and len(sg) == 64 for pk, sg in zip(pubkeys, sigs))
+    if len_ok:
+        # Fast path (every batch from commit verification): two joins +
+        # one prefixed C hash call — no per-signature Python work.
+        pk_arr = np.frombuffer(b"".join(pubkeys), dtype=np.uint8).reshape(n, 32)
+        sig_arr = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(n, 64)
+        r_arr, s_arr = sig_arr[:, :32], sig_arr[:, 32:]
+        host_ok = _s_canonical(s_arr)
+        prefix = np.concatenate([r_arr, pk_arr], axis=1)  # (n, 64) = R || A
+        k_arr = reduce_mod_l(sha512_batch_prefixed(prefix, list(msgs)))
+    else:
+        host_ok = np.ones(n, dtype=bool)
+        pk_arr = np.zeros((n, 32), dtype=np.uint8)
+        r_arr = np.zeros((n, 32), dtype=np.uint8)
+        s_arr = np.zeros((n, 32), dtype=np.uint8)
+        hash_inputs: List[bytes] = []
+        hash_rows: List[int] = []
+        for i, (pk, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
+            if len(pk) != 32 or len(sig) != 64:
+                host_ok[i] = False
+                continue
+            pk_arr[i] = np.frombuffer(pk, dtype=np.uint8)
+            r_arr[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+            s_arr[i] = np.frombuffer(sig[32:], dtype=np.uint8)
+            hash_inputs.append(sig[:32] + pk + msg)
+            hash_rows.append(i)
+        host_ok &= _s_canonical(s_arr)
+        k_arr = np.zeros((n, 32), dtype=np.uint8)
+        if hash_inputs:
+            k_list = sha512_batch_mod_l(hash_inputs)
+            rows = np.asarray(hash_rows)
+            k_arr[rows] = np.frombuffer(b"".join(k_list), dtype=np.uint8).reshape(
+                -1, 32
+            )
 
     m = pad_to if pad_to is not None else _bucket(n)
     if m > n:

@@ -1,0 +1,4 @@
+from tendermint_tpu.rpc.server import RPCServer
+from tendermint_tpu.rpc.core import Environment
+
+__all__ = ["RPCServer", "Environment"]

@@ -1,0 +1,621 @@
+"""RPC route handlers bound to a node Environment.
+
+Mirrors internal/rpc/core: the ``Environment`` struct holds handles to
+every service (routes.go:28-80, env.go), and each handler is a thin
+adapter from JSON params to those services. Route names and response
+shapes follow the reference; int64s are strings, hashes hex, tx bytes
+base64 (see rpc/encoding.py).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu import eventbus as eb
+from tendermint_tpu.libs.pubsub import Query, QueryError
+from tendermint_tpu.rpc import encoding as enc
+from tendermint_tpu.rpc.server import INVALID_PARAMS, RPCError
+
+
+def _to_bytes_param(v: Any) -> bytes:
+    """Accept hex ('0xAB' / bare hex) or base64 params (reference URI
+    and JSON clients use both)."""
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        if v.startswith("0x") or v.startswith("0X"):
+            return bytes.fromhex(v[2:])
+        try:
+            return base64.b64decode(v, validate=True)
+        except Exception:
+            try:
+                return bytes.fromhex(v)
+            except ValueError:
+                raise RPCError(INVALID_PARAMS, f"cannot decode bytes param: {v!r}")
+    raise RPCError(INVALID_PARAMS, f"cannot decode bytes param: {v!r}")
+
+
+class Environment:
+    """Service handles for RPC handlers (internal/rpc/core/env.go)."""
+
+    def __init__(
+        self,
+        *,
+        node_info=None,
+        genesis=None,
+        block_store=None,
+        state_store=None,
+        consensus=None,
+        mempool=None,
+        evidence_pool=None,
+        app_client=None,
+        event_bus: Optional[eb.EventBus] = None,
+        indexer=None,
+        peer_manager=None,
+        get_state: Optional[Callable] = None,
+        is_syncing: Optional[Callable[[], bool]] = None,
+    ):
+        self.node_info = node_info
+        self.genesis = genesis
+        self.block_store = block_store
+        self.state_store = state_store
+        self.consensus = consensus
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
+        self.app = app_client
+        self.event_bus = event_bus
+        self.indexer = indexer
+        self.peer_manager = peer_manager
+        self.get_state = get_state or (lambda: None)
+        self.is_syncing = is_syncing or (lambda: False)
+
+    # -- route table ----------------------------------------------------------
+
+    def routes(self) -> Dict[str, Callable]:
+        """internal/rpc/core/routes.go:28-80."""
+        return {
+            "health": self.health,
+            "status": self.status,
+            "net_info": self.net_info,
+            "blockchain": self.blockchain,
+            "genesis": self.genesis_route,
+            "block": self.block,
+            "block_by_hash": self.block_by_hash,
+            "block_results": self.block_results,
+            "commit": self.commit,
+            "header": self.header,
+            "header_by_hash": self.header_by_hash,
+            "validators": self.validators,
+            "consensus_state": self.consensus_state,
+            "dump_consensus_state": self.consensus_state,
+            "consensus_params": self.consensus_params,
+            "unconfirmed_txs": self.unconfirmed_txs,
+            "num_unconfirmed_txs": self.num_unconfirmed_txs,
+            "broadcast_tx_sync": self.broadcast_tx_sync,
+            "broadcast_tx_async": self.broadcast_tx_sync,  # alias, routes.go:64
+            "broadcast_tx_commit": self.broadcast_tx_commit,
+            "check_tx": self.check_tx,
+            "tx": self.tx,
+            "tx_search": self.tx_search,
+            "block_search": self.block_search,
+            "abci_query": self.abci_query,
+            "abci_info": self.abci_info,
+            "broadcast_evidence": self.broadcast_evidence,
+            "events": self.events,
+            "subscribe": self.subscribe_poll,
+        }
+
+    # -- info routes ----------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        return {}
+
+    def status(self) -> Dict[str, Any]:
+        state = self.get_state()
+        latest_height = self.block_store.height() if self.block_store else 0
+        latest_meta = (
+            self.block_store.load_block_meta(latest_height)
+            if latest_height > 0
+            else None
+        )
+        val_info = {}
+        if state is not None and self.consensus is not None:
+            pv = getattr(self.consensus, "priv_validator", None)
+            if pv is not None:
+                addr = pv.get_pub_key().address()
+                _, val = state.validators.get_by_address(addr)
+                val_info = {
+                    "address": enc.hex_bytes(addr),
+                    "pub_key": {
+                        "type": pv.get_pub_key().type,
+                        "value": enc.b64(pv.get_pub_key().bytes()),
+                    },
+                    "voting_power": str(val.voting_power if val else 0),
+                }
+        return {
+            "node_info": self._node_info_json(),
+            "sync_info": {
+                "latest_block_hash": enc.hex_bytes(
+                    latest_meta.block_id.hash if latest_meta else b""
+                ),
+                "latest_app_hash": enc.hex_bytes(
+                    state.app_hash if state is not None else b""
+                ),
+                "latest_block_height": str(latest_height),
+                "latest_block_time": enc.rfc3339(
+                    latest_meta.header.time
+                    if latest_meta
+                    else enc.Timestamp(0, 0)
+                ),
+                "earliest_block_height": str(
+                    self.block_store.base() if self.block_store else 0
+                ),
+                "catching_up": bool(self.is_syncing()),
+            },
+            "validator_info": val_info,
+        }
+
+    def _node_info_json(self) -> Dict[str, Any]:
+        ni = self.node_info
+        if ni is None:
+            return {}
+        return {
+            "id": getattr(ni, "node_id", ""),
+            "listen_addr": getattr(ni, "listen_addr", ""),
+            "network": getattr(ni, "network", ""),
+            "version": getattr(ni, "version", ""),
+            "moniker": getattr(ni, "moniker", ""),
+        }
+
+    def net_info(self) -> Dict[str, Any]:
+        peers = []
+        if self.peer_manager is not None:
+            for pid in self.peer_manager.connected_peers():
+                peers.append({"node_id": pid})
+        return {
+            "listening": True,
+            "listeners": [],
+            "n_peers": str(len(peers)),
+            "peers": peers,
+        }
+
+    def genesis_route(self) -> Dict[str, Any]:
+        g = self.genesis
+        return {
+            "genesis": {
+                "genesis_time": enc.rfc3339(g.genesis_time),
+                "chain_id": g.chain_id,
+                "initial_height": str(g.initial_height),
+                "app_hash": enc.hex_bytes(g.app_hash),
+                "validators": [
+                    {
+                        "address": enc.hex_bytes(v.address),
+                        "pub_key": {"type": v.pub_key.type, "value": enc.b64(v.pub_key.bytes())},
+                        "power": str(v.power),
+                        "name": "",
+                    }
+                    for v in g.validators
+                ],
+            }
+        }
+
+    # -- block routes ---------------------------------------------------------
+
+    def _height_param(self, height, default_latest: bool = True) -> int:
+        if height is None or height == "":
+            if not default_latest:
+                raise RPCError(INVALID_PARAMS, "height required")
+            return self.block_store.height()
+        h = int(height)
+        if h <= 0:
+            return self.block_store.height()
+        return h
+
+    def blockchain(self, minHeight=None, maxHeight=None, min_height=None, max_height=None) -> Dict[str, Any]:
+        lo = int(minHeight if minHeight is not None else (min_height or 1))
+        latest = self.block_store.height()
+        hi = int(maxHeight if maxHeight is not None else (max_height or latest))
+        hi = min(hi if hi > 0 else latest, latest)
+        lo = max(lo, self.block_store.base(), hi - 19)
+        metas = []
+        for h in range(hi, lo - 1, -1):
+            m = self.block_store.load_block_meta(h)
+            if m is None:
+                continue
+            metas.append(
+                {
+                    "block_id": enc.block_id_json(m.block_id),
+                    "block_size": str(m.block_size),
+                    "header": enc.header_json(m.header),
+                    "num_txs": str(m.num_txs),
+                }
+            )
+        return {"last_height": str(latest), "block_metas": metas}
+
+    def block(self, height=None) -> Dict[str, Any]:
+        h = self._height_param(height)
+        blk = self.block_store.load_block(h)
+        meta = self.block_store.load_block_meta(h)
+        if blk is None:
+            raise RPCError(INVALID_PARAMS, f"no block at height {h}")
+        return {
+            "block_id": enc.block_id_json(meta.block_id),
+            "block": enc.block_json(blk),
+        }
+
+    def block_by_hash(self, hash=None) -> Dict[str, Any]:
+        blk = self.block_store.load_block_by_hash(_to_bytes_param(hash))
+        if blk is None:
+            return {"block_id": None, "block": None}
+        return self.block(blk.header.height)
+
+    def header(self, height=None) -> Dict[str, Any]:
+        h = self._height_param(height)
+        meta = self.block_store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(INVALID_PARAMS, f"no header at height {h}")
+        return {"header": enc.header_json(meta.header)}
+
+    def header_by_hash(self, hash=None) -> Dict[str, Any]:
+        blk = self.block_store.load_block_by_hash(_to_bytes_param(hash))
+        if blk is None:
+            return {"header": None}
+        return {"header": enc.header_json(blk.header)}
+
+    def commit(self, height=None) -> Dict[str, Any]:
+        h = self._height_param(height)
+        meta = self.block_store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(INVALID_PARAMS, f"no block at height {h}")
+        canonical = True
+        c = self.block_store.load_block_commit(h)
+        if c is None:
+            c = self.block_store.load_seen_commit()
+            canonical = False
+            if c is None or c.height != h:
+                raise RPCError(INVALID_PARAMS, f"no commit for height {h}")
+        return {
+            "signed_header": {
+                "header": enc.header_json(meta.header),
+                "commit": enc.commit_json(c),
+            },
+            "canonical": canonical,
+        }
+
+    def block_results(self, height=None) -> Dict[str, Any]:
+        h = self._height_param(height)
+        raw = self.state_store.load_finalize_block_response(h)
+        if raw is None:
+            raise RPCError(INVALID_PARAMS, f"no results for height {h}")
+        from tendermint_tpu.state.execution import _unmarshal_finalize_response
+
+        fres = _unmarshal_finalize_response(raw)
+        return {
+            "height": str(h),
+            "txs_results": [enc.exec_tx_result_json(r) for r in fres.tx_results],
+            "finalize_block_events": [enc.event_json(e) for e in fres.events],
+            "validator_updates": [
+                {"pub_key_type": u.pub_key_type, "power": str(u.power)}
+                for u in fres.validator_updates
+            ],
+            "app_hash": enc.hex_bytes(fres.app_hash),
+        }
+
+    def validators(self, height=None, page=1, per_page=30) -> Dict[str, Any]:
+        h = self._height_param(height)
+        vset = self.state_store.load_validators(h)
+        vals = vset.validators
+        page = max(1, int(page))
+        per_page = max(1, min(100, int(per_page)))
+        start = (page - 1) * per_page
+        sel = vals[start : start + per_page]
+        return {
+            "block_height": str(h),
+            "validators": [enc.validator_json(v) for v in sel],
+            "count": str(len(sel)),
+            "total": str(len(vals)),
+        }
+
+    def consensus_params(self, height=None) -> Dict[str, Any]:
+        h = self._height_param(height)
+        params = self.state_store.load_consensus_params(h)
+        return {
+            "block_height": str(h),
+            "consensus_params": {
+                "block": {
+                    "max_bytes": str(params.block.max_bytes),
+                    "max_gas": str(params.block.max_gas),
+                },
+                "evidence": {
+                    "max_age_num_blocks": str(params.evidence.max_age_num_blocks),
+                    "max_age_duration": str(params.evidence.max_age_duration),
+                    "max_bytes": str(params.evidence.max_bytes),
+                },
+                "validator": {
+                    "pub_key_types": list(params.validator.pub_key_types)
+                },
+            },
+        }
+
+    def consensus_state(self) -> Dict[str, Any]:
+        cs = self.consensus
+        if cs is None:
+            return {"round_state": None}
+        rs = getattr(cs, "rs", None)
+        if rs is None:
+            return {"round_state": None}
+        return {
+            "round_state": {
+                "height": str(rs.height),
+                "round": rs.round,
+                "step": rs.step.name,
+            }
+        }
+
+    # -- mempool routes -------------------------------------------------------
+
+    def unconfirmed_txs(self, page=1, per_page=30) -> Dict[str, Any]:
+        txs = self.mempool.tx_list()
+        page = max(1, int(page))
+        per_page = max(1, min(100, int(per_page)))
+        sel = txs[(page - 1) * per_page : (page - 1) * per_page + per_page]
+        return {
+            "n_txs": str(len(sel)),
+            "total": str(len(txs)),
+            "total_bytes": str(self.mempool.size_bytes()),
+            "txs": [enc.b64(t) for t in sel],
+        }
+
+    def num_unconfirmed_txs(self) -> Dict[str, Any]:
+        return {
+            "n_txs": str(len(self.mempool)),
+            "total": str(len(self.mempool)),
+            "total_bytes": str(self.mempool.size_bytes()),
+        }
+
+    def check_tx(self, tx=None) -> Dict[str, Any]:
+        raw = _to_bytes_param(tx)
+        res = self.app.check_tx(abci.RequestCheckTx(tx=raw))
+        return {"code": res.code, "codespace": res.codespace, "data": enc.b64(res.data)}
+
+    def broadcast_tx_sync(self, tx=None) -> Dict[str, Any]:
+        raw = _to_bytes_param(tx)
+        res = self.mempool.check_tx(raw)
+        return {
+            "code": res.code,
+            "data": enc.b64(res.data),
+            "codespace": res.codespace,
+            "hash": enc.hex_bytes(hashlib.sha256(raw).digest()),
+        }
+
+    def broadcast_tx_commit(self, tx=None, timeout: float = 30.0) -> Dict[str, Any]:
+        """mempool.go DeliverTx flow: CheckTx, then wait for the tx event
+        (internal/rpc/core/mempool.go:48-108)."""
+        raw = _to_bytes_param(tx)
+        tx_hash = hashlib.sha256(raw).hexdigest().upper()
+        if self.event_bus is None:
+            raise RPCError(INVALID_PARAMS, "event bus not configured")
+        subscriber = f"tx-commit-{tx_hash[:16]}-{time.monotonic_ns()}"
+        sub = self.event_bus.subscribe(
+            subscriber, f"{eb.TX_HASH_KEY} = '{tx_hash}'", capacity=4
+        )
+        try:
+            res = self.mempool.check_tx(raw)
+            out: Dict[str, Any] = {
+                "check_tx": {
+                    "code": res.code,
+                    "data": enc.b64(res.data),
+                    "codespace": res.codespace,
+                },
+                "hash": tx_hash,
+                "height": "0",
+            }
+            if res.code != abci.CODE_TYPE_OK:
+                return out
+            msg = sub.next(timeout=timeout)
+            if msg is None:
+                out["tx_result"] = None
+                out["error"] = "timed out waiting for tx to be included in a block"
+                return out
+            data = msg.data
+            out["tx_result"] = enc.exec_tx_result_json(data.result)
+            out["height"] = str(data.height)
+            return out
+        finally:
+            self.event_bus.unsubscribe_all(subscriber)
+
+    # -- query routes ---------------------------------------------------------
+
+    def tx(self, hash=None, prove=False) -> Dict[str, Any]:
+        if self.indexer is None:
+            raise RPCError(INVALID_PARAMS, "tx indexing disabled")
+        h = _to_bytes_param(hash)
+        tr = self.indexer.get_tx(h)
+        if tr is None:
+            raise RPCError(INVALID_PARAMS, f"tx not found: {h.hex()}")
+        return {
+            "hash": enc.hex_bytes(h),
+            "height": str(tr.height),
+            "index": tr.index,
+            "tx_result": enc.exec_tx_result_json(tr.result),
+            "tx": enc.b64(tr.tx),
+        }
+
+    def tx_search(self, query=None, page=1, per_page=30, order_by="asc") -> Dict[str, Any]:
+        if self.indexer is None:
+            raise RPCError(INVALID_PARAMS, "tx indexing disabled")
+        try:
+            q = Query.parse(query or "")
+        except QueryError as e:
+            raise RPCError(INVALID_PARAMS, str(e))
+        results = self.indexer.search_txs(q, limit=10000)
+        if order_by == "desc":
+            results = results[::-1]
+        page = max(1, int(page))
+        per_page = max(1, min(100, int(per_page)))
+        sel = results[(page - 1) * per_page : (page - 1) * per_page + per_page]
+        return {
+            "txs": [
+                {
+                    "hash": enc.hex_bytes(t.hash()),
+                    "height": str(t.height),
+                    "index": t.index,
+                    "tx_result": enc.exec_tx_result_json(t.result),
+                    "tx": enc.b64(t.tx),
+                }
+                for t in sel
+            ],
+            "total_count": str(len(results)),
+        }
+
+    def block_search(self, query=None, page=1, per_page=30, order_by="asc") -> Dict[str, Any]:
+        if self.indexer is None:
+            raise RPCError(INVALID_PARAMS, "block indexing disabled")
+        try:
+            q = Query.parse(query or "")
+        except QueryError as e:
+            raise RPCError(INVALID_PARAMS, str(e))
+        heights = self.indexer.search_block_heights(q, limit=10000)
+        if order_by == "desc":
+            heights = heights[::-1]
+        page = max(1, int(page))
+        per_page = max(1, min(100, int(per_page)))
+        sel = heights[(page - 1) * per_page : (page - 1) * per_page + per_page]
+        blocks = []
+        for h in sel:
+            meta = self.block_store.load_block_meta(h)
+            blk = self.block_store.load_block(h)
+            if meta is None or blk is None:
+                continue
+            blocks.append(
+                {"block_id": enc.block_id_json(meta.block_id), "block": enc.block_json(blk)}
+            )
+        return {"blocks": blocks, "total_count": str(len(heights))}
+
+    # -- ABCI routes ----------------------------------------------------------
+
+    def abci_query(self, path="", data=None, height=0, prove=False) -> Dict[str, Any]:
+        raw = _to_bytes_param(data) if data else b""
+        res = self.app.query(
+            abci.RequestQuery(data=raw, path=path, height=int(height), prove=bool(prove))
+        )
+        return {
+            "response": {
+                "code": res.code,
+                "log": res.log,
+                "info": res.info,
+                "index": str(res.index),
+                "key": enc.b64(res.key),
+                "value": enc.b64(res.value),
+                "height": str(res.height),
+                "codespace": res.codespace,
+            }
+        }
+
+    def abci_info(self) -> Dict[str, Any]:
+        res = self.app.info(abci.RequestInfo())
+        return {
+            "response": {
+                "data": res.data,
+                "version": res.version,
+                "app_version": str(res.app_version),
+                "last_block_height": str(res.last_block_height),
+                "last_block_app_hash": enc.b64(res.last_block_app_hash),
+            }
+        }
+
+    def broadcast_evidence(self, evidence=None) -> Dict[str, Any]:
+        from tendermint_tpu.types.evidence import evidence_from_proto_bytes
+
+        ev = evidence_from_proto_bytes(_to_bytes_param(evidence))
+        self.evidence_pool.add_evidence(ev)
+        return {"hash": enc.hex_bytes(ev.hash())}
+
+    # -- event routes ---------------------------------------------------------
+
+    def events(self, filter=None, maxItems=100, after=0, waitTime=5.0) -> Dict[str, Any]:
+        """Long-poll over the sliding-window event log
+        (internal/rpc/core/events.go:103, eventlog-backed /events)."""
+        if self.event_bus is None:
+            raise RPCError(INVALID_PARAMS, "event bus not configured")
+        q = None
+        if filter:
+            fq = filter.get("query") if isinstance(filter, dict) else filter
+            if fq:
+                try:
+                    q = Query.parse(fq)
+                except QueryError as e:
+                    raise RPCError(INVALID_PARAMS, str(e))
+        items, more, resume = self.event_bus.eventlog.scan(
+            query=q,
+            after=int(after),
+            max_items=min(int(maxItems), 500),
+            wait=min(float(waitTime), 30.0),
+        )
+        return {
+            "items": [
+                {
+                    "cursor": str(it.cursor),
+                    "event": it.type,
+                    "data": _event_data_json(it.data),
+                }
+                for it in items
+            ],
+            "more": more,
+            "oldest": str(items[0].cursor) if items else "0",
+            # resume cursor: pass back as `after` — never skips events
+            # even when the response was truncated.
+            "newest": str(resume),
+        }
+
+    def subscribe_poll(self, query=None, after=0, waitTime=5.0, maxItems=100) -> Dict[str, Any]:
+        """Long-poll subscribe: same contract as /events but keyed by the
+        caller's query (the reference's websocket subscribe is replaced
+        by cursor-based polling; see server.py docstring)."""
+        return self.events(
+            filter={"query": query} if query else None,
+            maxItems=maxItems,
+            after=after,
+            waitTime=waitTime,
+        )
+
+
+def _event_data_json(data: object) -> Dict[str, Any]:
+    if isinstance(data, eb.EventDataNewBlock):
+        return {
+            "type": "new_block",
+            "height": str(data.block.header.height),
+            "block": enc.block_json(data.block),
+        }
+    if isinstance(data, eb.EventDataTx):
+        return {
+            "type": "tx",
+            "height": str(data.height),
+            "index": data.index,
+            "tx": enc.b64(data.tx),
+            "result": enc.exec_tx_result_json(data.result),
+        }
+    if isinstance(data, eb.EventDataNewBlockHeader):
+        return {"type": "new_block_header", "header": enc.header_json(data.header)}
+    if isinstance(data, eb.EventDataNewRound):
+        return {
+            "type": "new_round",
+            "height": str(data.height),
+            "round": data.round,
+            "step": data.step,
+        }
+    if isinstance(data, eb.EventDataRoundState):
+        return {
+            "type": "round_state",
+            "height": str(data.height),
+            "round": data.round,
+            "step": data.step,
+        }
+    if isinstance(data, eb.EventDataValidatorSetUpdates):
+        return {"type": "validator_set_updates"}
+    return {"type": type(data).__name__}

@@ -1,0 +1,173 @@
+"""JSON-RPC 2.0 server over HTTP.
+
+The reference serves ~35 routes over HTTP POST (JSON-RPC envelope), GET
+(URI params), and websocket (rpc/jsonrpc/server/). This server covers
+the POST/GET surface with Python's threading HTTP server and replaces
+the websocket stream with the reference's own newer alternative: the
+``/events`` long-poll endpoint backed by the sliding-window eventlog
+(internal/eventlog/eventlog.go:25, internal/rpc/core/events.go:103) —
+same data, no custom framing protocol.
+
+Handlers come from an rpc.core.Environment-bound route table; params
+arrive as JSON object/array (POST) or query strings (GET).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qsl, urlparse
+
+
+class RPCError(Exception):
+    """JSON-RPC error with code (rpc/jsonrpc/types/types.go)."""
+
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+PARSE_ERROR = -32700
+INVALID_REQUEST = -32600
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+INTERNAL_ERROR = -32603
+
+
+class RPCServer:
+    """Threaded HTTP JSON-RPC server bound to a route table."""
+
+    def __init__(self, routes: Dict[str, Callable], host: str = "127.0.0.1", port: int = 0):
+        self.routes = routes
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                try:
+                    req = json.loads(body or b"{}")
+                except json.JSONDecodeError:
+                    self._reply(None, error=(PARSE_ERROR, "parse error", ""))
+                    return
+                if isinstance(req, list):
+                    out = [server._dispatch(r) for r in req]
+                    self._send(200, json.dumps(out).encode())
+                    return
+                self._send(200, json.dumps(server._dispatch(req)).encode())
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                method = parsed.path.strip("/")
+                if method == "":
+                    self._send(200, server._index().encode())
+                    return
+                params: Dict[str, Any] = {}
+                for k, v in parse_qsl(parsed.query):
+                    # heuristics matching the reference's URI param
+                    # decoding: quoted strings, 0x-hex, numbers, bools
+                    if v.startswith('"') and v.endswith('"') and len(v) >= 2:
+                        params[k] = v[1:-1]
+                    elif v in ("true", "false"):
+                        params[k] = v == "true"
+                    else:
+                        try:
+                            params[k] = int(v)
+                        except ValueError:
+                            params[k] = v
+                req = {"jsonrpc": "2.0", "id": -1, "method": method, "params": params}
+                self._send(200, json.dumps(server._dispatch(req)).encode())
+
+            def _reply(self, result, error=None, id_=None):
+                resp: Dict[str, Any] = {"jsonrpc": "2.0", "id": id_}
+                if error is not None:
+                    code, msg, data = error
+                    resp["error"] = {"code": code, "message": msg, "data": data}
+                else:
+                    resp["result"] = result
+                self._send(200, json.dumps(resp).encode())
+
+            def _send(self, status: int, body: bytes):
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="rpc-server"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    # -- dispatch -------------------------------------------------------------
+
+    def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        id_ = req.get("id")
+        resp: Dict[str, Any] = {"jsonrpc": "2.0", "id": id_}
+        method = req.get("method")
+        fn = self.routes.get(method or "")
+        if fn is None:
+            resp["error"] = {
+                "code": METHOD_NOT_FOUND,
+                "message": f"method not found: {method}",
+            }
+            return resp
+        params = req.get("params") or {}
+        try:
+            if isinstance(params, dict):
+                result = fn(**params)
+            elif isinstance(params, list):
+                result = fn(*params)
+            else:
+                raise RPCError(INVALID_PARAMS, "params must be object or array")
+            resp["result"] = result
+        except RPCError as e:
+            resp["error"] = {"code": e.code, "message": e.message, "data": e.data}
+        except TypeError as e:
+            resp["error"] = {"code": INVALID_PARAMS, "message": str(e)}
+        except Exception as e:  # internal
+            resp["error"] = {
+                "code": INTERNAL_ERROR,
+                "message": str(e),
+                "data": traceback.format_exc(limit=5),
+            }
+        return resp
+
+    def _index(self) -> str:
+        lines = ["Available endpoints:"]
+        lines += sorted(f"  /{name}" for name in self.routes)
+        return "\n".join(lines)

@@ -463,6 +463,34 @@ def _evidence_to_abci(evidence: List[Evidence]) -> List[abci.Misbehavior]:
     return out
 
 
+def _unmarshal_finalize_response(raw: bytes) -> abci.ResponseFinalizeBlock:
+    """Inverse of _marshal_finalize_response (RPC /block_results reads
+    the persisted subset back; log/info/events are not retained)."""
+    import json
+
+    d = json.loads(raw.decode())
+    return abci.ResponseFinalizeBlock(
+        app_hash=bytes.fromhex(d["app_hash"]),
+        tx_results=[
+            abci.ExecTxResult(
+                code=r["code"],
+                data=bytes.fromhex(r["data"]),
+                gas_wanted=r["gas_wanted"],
+                gas_used=r["gas_used"],
+            )
+            for r in d["tx_results"]
+        ],
+        validator_updates=[
+            abci.ValidatorUpdate(
+                pub_key_type=vu["type"],
+                pub_key_bytes=bytes.fromhex(vu["pub_key"]),
+                power=vu["power"],
+            )
+            for vu in d["validator_updates"]
+        ],
+    )
+
+
 def _marshal_finalize_response(fres: abci.ResponseFinalizeBlock) -> bytes:
     """Compact persistence of the FinalizeBlock response for replay."""
     import json
