@@ -1,24 +1,34 @@
-"""Consensus reactor: gossips proposals, block parts, and votes.
+"""Consensus reactor: targeted per-peer gossip of proposals, parts, votes.
 
 Mirrors internal/consensus/reactor.go's channel layout — State(0x20),
-Data(0x21), Vote(0x22), VoteSetBits(0x23) (reactor.go:78-81) — with a
-broadcast-based gossip discipline: own proposals/parts/votes are
-broadcast to all peers, peer messages feed the state machine's peer
-queue. (The reference's per-peer PeerState-driven catch-up gossip is
-approximated by rebroadcasting on NewRoundStep; targeted catch-up rides
-blocksync.)
+Data(0x21), Vote(0x22), VoteSetBits(0x23) (reactor.go:78-81) — and its
+gossip discipline: one gossip routine per peer consults that peer's
+PeerState and sends only what the peer is missing (gossipDataRoutine
+reactor.go:501, gossipVotesRoutine reactor.go:736), with block-part +
+commit catch-up for peers on older heights (gossipDataForCatchup
+reactor.go:437). Peers announce state via NewRoundStep, HasVote, and
+periodic VoteSetBits; everything a peer sends also updates its
+PeerState, so re-sends converge to zero once a peer is caught up.
 
-Wire format per message: 1 tag byte + proto payload.
+Wire format per message: 1 tag byte + payload (struct-packed fields,
+proto payloads for types).
 """
 
 from __future__ import annotations
 
 import struct
 import threading
-from typing import Optional
+import time
+from typing import Dict, Optional
 
+from tendermint_tpu.consensus.peer_state import PeerState
 from tendermint_tpu.consensus.state import Broadcaster, ConsensusState
+from tendermint_tpu.libs.bits import BitArray
 from tendermint_tpu.p2p.router import Channel, Envelope, Router
+from tendermint_tpu.encoding.canonical import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+)
 from tendermint_tpu.types.block import Proposal, Vote
 from tendermint_tpu.types.part_set import Part
 
@@ -31,10 +41,29 @@ TAG_NEW_ROUND_STEP = 1
 TAG_PROPOSAL = 2
 TAG_BLOCK_PART = 3
 TAG_VOTE = 4
+TAG_HAS_VOTE = 5
+TAG_VOTE_SET_BITS = 6
+
+# How long gossip routines sleep when a peer needs nothing
+# (peerGossipSleepDuration reactor.go:119 is 100ms; smaller here because
+# test networks run sub-second rounds).
+GOSSIP_SLEEP = 0.02
+# Votes sent per gossip iteration when a peer is behind on votes.
+VOTES_PER_ITER = 8
+# Interval between VoteSetBits announcements of our own vote bitmaps.
+BITS_INTERVAL = 0.5
+# Upper bound on wire-supplied validator indices / bit-array sizes; a
+# peer claiming more validators than this is lying (the reference bounds
+# set size via MaxTotalVotingPower, validator_set.go:18-25).
+MAX_WIRE_VALIDATORS = 65536
 
 
-def encode_new_round_step(height: int, round_: int, step: int) -> bytes:
-    return bytes([TAG_NEW_ROUND_STEP]) + struct.pack(">qii", height, round_, step)
+def encode_new_round_step(
+    height: int, round_: int, step: int, last_commit_round: int
+) -> bytes:
+    return bytes([TAG_NEW_ROUND_STEP]) + struct.pack(
+        ">qiii", height, round_, step, last_commit_round
+    )
 
 
 def encode_proposal(p: Proposal) -> bytes:
@@ -53,9 +82,39 @@ def encode_vote(v: Vote) -> bytes:
     return bytes([TAG_VOTE]) + v.to_proto_bytes()
 
 
+def encode_has_vote(height: int, round_: int, type_: int, index: int) -> bytes:
+    return bytes([TAG_HAS_VOTE]) + struct.pack(">qibi", height, round_, type_, index)
+
+
+def encode_vote_set_bits(
+    height: int, round_: int, type_: int, bits: BitArray
+) -> bytes:
+    return (
+        bytes([TAG_VOTE_SET_BITS])
+        + struct.pack(">qibi", height, round_, type_, bits.size())
+        + bytes(bits._elems)
+    )
+
+
+def decode_vote_set_bits(payload: bytes):
+    """Returns (height, round, type, bits) or None for malformed/hostile
+    input (oversized nbits would allocate unboundedly; a short payload
+    would leave the BitArray's backing storage inconsistent)."""
+    height, round_, type_, nbits = struct.unpack_from(">qibi", payload)
+    if nbits < 0 or nbits > MAX_WIRE_VALIDATORS:
+        return None
+    ba = BitArray(nbits)
+    body = payload[struct.calcsize(">qibi") :]
+    if len(body) != len(ba._elems):
+        return None
+    ba._elems[:] = body
+    return height, round_, type_, ba
+
+
 class ConsensusReactor(Broadcaster):
     def __init__(self, cs: ConsensusState, router: Router):
         self.cs = cs
+        self.router = router
         self.state_ch = router.open_channel(STATE_CHANNEL)
         self.data_ch = router.open_channel(DATA_CHANNEL)
         self.vote_ch = router.open_channel(VOTE_CHANNEL)
@@ -63,6 +122,9 @@ class ConsensusReactor(Broadcaster):
         cs.broadcaster = self
         self._stop_flag = threading.Event()
         self._threads = []
+        self._peers: Dict[str, PeerState] = {}
+        self._gossip_threads: Dict[str, threading.Thread] = {}
+        self._peers_mtx = threading.Lock()
 
     def start(self) -> None:
         self._stop_flag.clear()
@@ -70,19 +132,17 @@ class ConsensusReactor(Broadcaster):
             (self.state_ch, self._handle_state),
             (self.data_ch, self._handle_data),
             (self.vote_ch, self._handle_vote),
+            (self.vote_bits_ch, self._handle_vote_bits),
         ):
             t = threading.Thread(
                 target=self._recv_loop, args=(ch, handler), daemon=True
             )
             t.start()
             self._threads.append(t)
-        # Catch-up gossip: peers that connect (or fall behind) after a
-        # message was first broadcast would never see it — the reference
-        # solves this with per-peer gossip routines driven by PeerState
-        # (reactor.go:501,736); here a periodic re-broadcast of the current
-        # round's proposal/parts/votes serves the same role (receivers
-        # dedupe cheaply before any signature work).
-        t = threading.Thread(target=self._regossip_loop, daemon=True)
+        t = threading.Thread(target=self._peer_lifecycle_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._announce_loop, daemon=True)
         t.start()
         self._threads.append(t)
 
@@ -91,6 +151,49 @@ class ConsensusReactor(Broadcaster):
         for t in self._threads:
             t.join(timeout=2)
         self._threads.clear()
+        with self._peers_mtx:
+            gossipers = list(self._gossip_threads.values())
+            self._gossip_threads.clear()
+            self._peers.clear()
+        for t in gossipers:
+            t.join(timeout=2)
+
+    # --- peer lifecycle -------------------------------------------------------
+
+    def _peer_lifecycle_loop(self) -> None:
+        """Track router connections; one gossip routine per live peer
+        (the reference subscribes to PeerUpdates, reactor.go:392)."""
+        while not self._stop_flag.is_set():
+            try:
+                connected = set(self.router.connected_peers())
+                with self._peers_mtx:
+                    for pid in connected:
+                        if pid not in self._gossip_threads:
+                            ps = self._peers.get(pid) or PeerState(pid)
+                            self._peers[pid] = ps
+                            t = threading.Thread(
+                                target=self._gossip_routine,
+                                args=(ps,),
+                                daemon=True,
+                                name=f"cs-gossip-{pid[:8]}",
+                            )
+                            self._gossip_threads[pid] = t
+                            t.start()
+                    for pid in list(self._gossip_threads):
+                        if pid not in connected:
+                            del self._gossip_threads[pid]
+                            self._peers.pop(pid, None)
+            except Exception:
+                pass
+            self._stop_flag.wait(0.1)
+
+    def _peer(self, peer_id: str) -> PeerState:
+        with self._peers_mtx:
+            ps = self._peers.get(peer_id)
+            if ps is None:
+                ps = PeerState(peer_id)
+                self._peers[peer_id] = ps
+            return ps
 
     # --- outbound (Broadcaster) ----------------------------------------------
 
@@ -101,47 +204,199 @@ class ConsensusReactor(Broadcaster):
         self.data_ch.broadcast(encode_block_part(height, round_, part))
 
     def broadcast_vote(self, vote: Vote) -> None:
+        # The SM announces HasVote separately when the vote lands in a set.
         self.vote_ch.broadcast(encode_vote(vote))
 
+    def broadcast_has_vote(
+        self, height: int, round_: int, type_: int, index: int
+    ) -> None:
+        self.state_ch.broadcast(encode_has_vote(height, round_, type_, index))
+
     def broadcast_new_round_step(self, rs) -> None:
+        lcr = rs.last_commit.round if rs.last_commit is not None else -1
         self.state_ch.broadcast(
-            encode_new_round_step(rs.height, rs.round, int(rs.step))
+            encode_new_round_step(rs.height, rs.round, int(rs.step), lcr)
         )
 
-    # --- catch-up gossip ------------------------------------------------------
+    # --- periodic announcements ----------------------------------------------
 
-    REGOSSIP_INTERVAL = 0.25
-
-    def _regossip_loop(self) -> None:
+    def _announce_loop(self) -> None:
+        """Broadcast NewRoundStep + our vote bitmaps periodically so late
+        joiners and message-drop victims re-converge (the role of the
+        reference's VoteSetMaj23/VoteSetBits query cycle, reactor.go:808)."""
         while not self._stop_flag.is_set():
-            self._stop_flag.wait(self.REGOSSIP_INTERVAL)
             try:
-                self._regossip_once()
+                rs = self.cs.rs
+                if rs.votes is not None:
+                    self.broadcast_new_round_step(rs)
+                    for type_, vs in (
+                        (SIGNED_MSG_TYPE_PREVOTE, rs.votes.prevotes(rs.round)),
+                        (SIGNED_MSG_TYPE_PRECOMMIT, rs.votes.precommits(rs.round)),
+                    ):
+                        if vs is not None:
+                            self.vote_bits_ch.broadcast(
+                                encode_vote_set_bits(
+                                    rs.height, rs.round, type_, vs.bit_array()
+                                )
+                            )
             except Exception:
                 pass
+            self._stop_flag.wait(BITS_INTERVAL)
 
-    def _regossip_once(self) -> None:
+    # --- per-peer gossip ------------------------------------------------------
+
+    def _gossip_routine(self, ps: PeerState) -> None:
+        """reactor.go gossipDataRoutine+gossipVotesRoutine merged: each
+        iteration sends the peer at most one part and a few votes."""
+        while not self._stop_flag.is_set():
+            with self._peers_mtx:
+                if self._gossip_threads.get(ps.peer_id) is not threading.current_thread():
+                    return  # unsubscribed
+            try:
+                sent = self._gossip_once(ps)
+            except Exception:
+                sent = False
+            if not sent:
+                self._stop_flag.wait(GOSSIP_SLEEP)
+
+    def _gossip_once(self, ps: PeerState) -> bool:
         rs = self.cs.rs
-        if rs.votes is None:
-            return
-        if rs.proposal is not None:
-            self.broadcast_proposal(rs.proposal)
-        if rs.proposal_block_parts is not None:
-            for i in range(rs.proposal_block_parts.total):
-                part = rs.proposal_block_parts.get_part(i)
+        p_height, p_round, p_step, p_lcr = ps.snapshot()
+        if p_height == 0:
+            return False  # no NewRoundStep from the peer yet
+
+        if p_height == rs.height:
+            return self._gossip_same_height(ps, rs, p_round)
+        if p_height < rs.height:
+            return self._gossip_catchup(ps, p_height, p_round, p_lcr)
+        return False  # peer ahead: blocksync pulls us forward, not gossip
+
+    def _gossip_same_height(self, ps: PeerState, rs, p_round: int) -> bool:
+        sent = False
+        # Proposal + parts for the peer's current round (reactor.go:501).
+        if p_round == rs.round and rs.proposal is not None and not ps.has_proposal:
+            self.data_ch.send(
+                Envelope(
+                    DATA_CHANNEL,
+                    encode_proposal(rs.proposal),
+                    to_peer=ps.peer_id,
+                )
+            )
+            ps.set_has_proposal(rs.height, rs.round)
+            sent = True
+        parts = rs.proposal_block_parts
+        if p_round == rs.round and parts is not None:
+            ps.init_parts(rs.height, rs.round, parts.header())
+            idx = ps.pick_missing_part(parts.parts_bit_array)
+            if idx is not None:
+                part = parts.get_part(idx)
                 if part is not None:
-                    self.broadcast_block_part(rs.height, rs.round, part)
-        for round_ in range(max(0, rs.round - 1), rs.round + 1):
-            for vs in (rs.votes.prevotes(round_), rs.votes.precommits(round_)):
-                if vs is None:
+                    self.data_ch.send(
+                        Envelope(
+                            DATA_CHANNEL,
+                            encode_block_part(rs.height, rs.round, part),
+                            to_peer=ps.peer_id,
+                        )
+                    )
+                    ps.set_has_part(rs.height, rs.round, idx)
+                    sent = True
+        # Votes: peer's round first, then our round, then POL round
+        # (gossipVotesForHeight reactor.go:640-700).
+        if rs.votes is not None:
+            rounds = []
+            for r in (p_round, rs.round, rs.valid_round):
+                if r >= 0 and r not in rounds:
+                    rounds.append(r)
+            for r in rounds:
+                for type_, vs in (
+                    (SIGNED_MSG_TYPE_PREVOTE, rs.votes.prevotes(r)),
+                    (SIGNED_MSG_TYPE_PRECOMMIT, rs.votes.precommits(r)),
+                ):
+                    if vs is None:
+                        continue
+                    if self._send_missing_votes(ps, vs, rs.height, r, type_):
+                        sent = True
+        return sent
+
+    def _send_missing_votes(self, ps, vote_set, height, round_, type_) -> bool:
+        ours = vote_set.bit_array()
+        sent = False
+        for _ in range(VOTES_PER_ITER):
+            idx = ps.pick_missing_vote(height, round_, type_, ours)
+            if idx is None:
+                break
+            vote = vote_set.get_by_index(idx)
+            if vote is None:
+                break
+            self.vote_ch.send(
+                Envelope(VOTE_CHANNEL, encode_vote(vote), to_peer=ps.peer_id)
+            )
+            ps.set_has_vote(height, round_, type_, idx, ours.size())
+            sent = True
+        return sent
+
+    def _gossip_catchup(self, ps: PeerState, p_height, p_round, p_lcr) -> bool:
+        """Peer is on an older height: serve the decided block's parts and
+        its commit from the store (gossipDataForCatchup reactor.go:437)."""
+        store = self.cs.block_store
+        if p_height < store.base():
+            return False
+        meta = store.load_block_meta(p_height)
+        commit = store.load_block_commit(p_height)
+        if commit is None:
+            # The canonical commit for p_height is only stored once block
+            # p_height+1 lands; until then the seen commit covers it
+            # (reference serves rs.LastCommit to height-1 peers,
+            # reactor.go:736).
+            seen = store.load_seen_commit()
+            if seen is not None and seen.height == p_height:
+                commit = seen
+        if meta is None:
+            return False
+        n_parts = meta.block_id.part_set_header.total
+        n_sigs = commit.size() if commit is not None else 0
+        ps.ensure_catchup(p_height, n_parts, n_sigs)
+        sent = False
+        # One part per iteration, preferring whatever the peer lacks.
+        theirs = ps.parts if ps.parts is not None else BitArray(0)
+        for i in range(n_parts):
+            if ps.catchup_parts.get_index(i) or theirs.get_index(i):
+                continue
+            part = store.load_block_part(p_height, i)
+            if part is None:
+                break
+            self.data_ch.send(
+                Envelope(
+                    DATA_CHANNEL,
+                    encode_block_part(p_height, p_round, part),
+                    to_peer=ps.peer_id,
+                )
+            )
+            ps.catchup_parts.set_index(i, True)
+            sent = True
+            break
+        # Commit precommits let the lagging peer finish its round
+        # (reactor.go:736 LastCommit case).
+        if commit is not None:
+            budget = VOTES_PER_ITER
+            for i in range(n_sigs):
+                if budget == 0:
+                    break
+                if ps.catchup_commit.get_index(i):
                     continue
-                for vote in vs.vote_list():
-                    self.broadcast_vote(vote)
-        # Last-height precommits so peers waiting in NewHeight can finish
-        # their commit (the LastCommit gossip of reactor.go:736).
-        if rs.last_commit is not None:
-            for vote in rs.last_commit.vote_list():
-                self.broadcast_vote(vote)
+                sig = commit.signatures[i]
+                if not sig.signature:
+                    ps.catchup_commit.set_index(i, True)
+                    continue
+                vote = commit.get_vote(i)
+                self.vote_ch.send(
+                    Envelope(VOTE_CHANNEL, encode_vote(vote), to_peer=ps.peer_id)
+                )
+                ps.catchup_commit.set_index(i, True)
+                ps.set_has_vote(vote.height, vote.round, vote.type, i, n_sigs)
+                sent = True
+                budget -= 1
+        return sent
 
     # --- inbound --------------------------------------------------------------
 
@@ -156,11 +411,16 @@ class ConsensusReactor(Broadcaster):
                 pass  # peer input must not kill the reactor
 
     def _handle_state(self, env: Envelope) -> None:
-        if not env.message or env.message[0] != TAG_NEW_ROUND_STEP:
+        if not env.message:
             return
-        height, round_, step = struct.unpack_from(">qii", env.message, 1)
-        # A peer behind us re-triggers our broadcasts implicitly via the
-        # internal loopback; a peer ahead is handled by blocksync.
+        tag = env.message[0]
+        if tag == TAG_NEW_ROUND_STEP:
+            height, round_, step, lcr = struct.unpack_from(">qiii", env.message, 1)
+            self._peer(env.from_peer).apply_new_round_step(height, round_, step, lcr)
+        elif tag == TAG_HAS_VOTE:
+            height, round_, type_, index = struct.unpack_from(">qibi", env.message, 1)
+            if 0 <= index < MAX_WIRE_VALIDATORS:
+                self._peer(env.from_peer).set_has_vote(height, round_, type_, index)
 
     def _handle_data(self, env: Envelope) -> None:
         if not env.message:
@@ -168,14 +428,31 @@ class ConsensusReactor(Broadcaster):
         tag = env.message[0]
         if tag == TAG_PROPOSAL:
             proposal = Proposal.from_proto_bytes(env.message[1:])
+            ps = self._peer(env.from_peer)
+            ps.set_has_proposal(proposal.height, proposal.round)
             self.cs.add_proposal_from_peer(proposal, env.from_peer)
         elif tag == TAG_BLOCK_PART:
             height, round_ = struct.unpack_from(">qi", env.message, 1)
             part = Part.from_proto_bytes(env.message[13:])
+            self._peer(env.from_peer).set_has_part(height, round_, part.index)
             self.cs.add_block_part_from_peer(height, round_, part, env.from_peer)
 
     def _handle_vote(self, env: Envelope) -> None:
         if not env.message or env.message[0] != TAG_VOTE:
             return
         vote = Vote.from_proto_bytes(env.message[1:])
+        if not (0 <= vote.validator_index < MAX_WIRE_VALIDATORS):
+            return
+        self._peer(env.from_peer).set_has_vote(
+            vote.height, vote.round, vote.type, vote.validator_index
+        )
         self.cs.add_vote_from_peer(vote, env.from_peer)
+
+    def _handle_vote_bits(self, env: Envelope) -> None:
+        if not env.message or env.message[0] != TAG_VOTE_SET_BITS:
+            return
+        decoded = decode_vote_set_bits(env.message[1:])
+        if decoded is None:
+            return
+        height, round_, type_, bits = decoded
+        self._peer(env.from_peer).apply_vote_set_bits(height, round_, type_, bits)

@@ -65,6 +65,10 @@ class Broadcaster:
 
     def broadcast_vote(self, vote: Vote) -> None: ...
 
+    def broadcast_has_vote(
+        self, height: int, round_: int, type_: int, index: int
+    ) -> None: ...
+
     def broadcast_new_round_step(self, rs) -> None: ...
 
 
@@ -765,6 +769,10 @@ class ConsensusState:
             if rs.last_commit is None:
                 return False
             added = rs.last_commit.add_vote(vote)
+            if added:
+                self.broadcaster.broadcast_has_vote(
+                    vote.height, vote.round, vote.type, vote.validator_index
+                )
             if added and (
                 self.state.consensus_params.timeout.bypass_commit_timeout
                 and rs.last_commit.has_all()
@@ -792,6 +800,11 @@ class ConsensusState:
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
             return False
+        # Announce to peers so their PeerState marks us as having it and
+        # their gossip routines skip re-sending (reactor HasVote flow).
+        self.broadcaster.broadcast_has_vote(
+            vote.height, vote.round, vote.type, vote.validator_index
+        )
 
         if vote.type == SIGNED_MSG_TYPE_PREVOTE:
             prevotes = rs.votes.prevotes(vote.round)

@@ -53,6 +53,10 @@ class EvidencePool:
         self.block_store = block_store
         self._mtx = threading.Lock()
         self.state: Optional[State] = None
+        # Conflicting-vote pairs from consensus, held until the height they
+        # belong to commits (pool.go consensusBuffer: evidence can only be
+        # verified once the header at its height exists in the store).
+        self._consensus_buffer: List[Tuple[Vote, Vote]] = []
 
     def set_state(self, state: State) -> None:
         self.state = state
@@ -91,20 +95,36 @@ class EvidencePool:
             self._db.set(_pending_key(ev), ev.to_proto_bytes())
 
     def report_conflicting_votes(self, vote_a: Vote, vote_b: Vote) -> None:
-        """pool.go ReportConflictingVotes (via consensus): build duplicate
-        vote evidence from the current state."""
-        if self.state is None:
-            return
-        try:
-            ev = DuplicateVoteEvidence.new(
-                vote_a,
-                vote_b,
-                self.state.last_block_time,
-                self.state.validators,
-            )
-            self.add_evidence(ev)
-        except (ValueError, InvalidEvidenceError):
-            pass
+        """pool.go ReportConflictingVotes: buffer the pair; evidence is
+        built in update() once the offending height has committed (the
+        header at that height must exist for verification)."""
+        with self._mtx:
+            self._consensus_buffer.append((vote_a, vote_b))
+
+    def _process_consensus_buffer(self, state: State) -> None:
+        """pool.go processConsensusBuffer (on Update)."""
+        with self._mtx:
+            buffered, keep = self._consensus_buffer, []
+            self._consensus_buffer = []
+        for vote_a, vote_b in buffered:
+            if vote_a.height > state.last_block_height:
+                keep.append((vote_a, vote_b))  # its height hasn't committed yet
+                continue
+            try:
+                val_set = (
+                    self.state_store.load_validators(vote_a.height)
+                    if self.state_store is not None
+                    else state.validators
+                )
+                ev = DuplicateVoteEvidence.new(
+                    vote_a, vote_b, state.last_block_time, val_set
+                )
+                self.add_evidence(ev)
+            except (ValueError, LookupError, InvalidEvidenceError):
+                pass
+        if keep:
+            with self._mtx:
+                self._consensus_buffer.extend(keep)
 
     # --- verification --------------------------------------------------------
 
@@ -197,13 +217,15 @@ class EvidencePool:
                 self.verify(ev)
 
     def update(self, state: State, block_evidence: List[Evidence]) -> None:
-        """pool.go Update: mark committed, prune expired."""
+        """pool.go Update: mark committed, prune expired, drain buffered
+        conflicting votes now that their height is in the store."""
         self.state = state
         with self._mtx:
             for ev in block_evidence:
                 self._db.set(_committed_key(ev), b"\x01")
                 self._db.delete(_pending_key(ev))
             self._prune_expired(state)
+        self._process_consensus_buffer(state)
 
     def _prune_expired(self, state: State) -> None:
         ev_params = state.consensus_params.evidence

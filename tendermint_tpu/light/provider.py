@@ -117,9 +117,12 @@ class HTTPProvider(Provider):
             raise LightBlockNotFoundError(msg)
         except OSError as e:
             raise ProviderError(str(e))
-        vset = ValidatorSet(vals)
-        # Preserve the proposer priorities the full node reported rather
-        # than recomputing (validators_hash must match the header).
+        # Preserve the priorities the full node reported: populate the set
+        # directly instead of via ValidatorSet(vals), which would re-run the
+        # change-set algorithm and re-increment priorities. The proposer is
+        # derived lazily from the reported priorities (get_proposer).
+        vset = ValidatorSet()
+        vset.validators = vals
         return LightBlock(
             signed_header=SignedHeader(
                 header=enc.header_from_json(c["signed_header"]["header"]),

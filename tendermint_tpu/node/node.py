@@ -40,7 +40,7 @@ from tendermint_tpu.privval import FilePV
 from tendermint_tpu.privval.base import PrivValidator
 from tendermint_tpu.state import StateStore, state_from_genesis
 from tendermint_tpu.state.execution import BlockExecutor
-from tendermint_tpu.storage import MemDB
+from tendermint_tpu.storage import open_db
 from tendermint_tpu.storage.blockstore import BlockStore
 from tendermint_tpu.types.genesis import GenesisDoc
 
@@ -60,6 +60,9 @@ class NodeConfig:
     moniker: str = "tpu-node"
     rpc_laddr: str = ""  # "host:port" enables the RPC server ("" = off)
     tx_index: bool = True
+    # tm-db backend selection (config/db.go:29): "memdb" or "filedb".
+    # filedb requires `home` (data lands in <home>/data/*.fdb).
+    db_backend: str = "memdb"
 
 
 class Node:
@@ -94,9 +97,11 @@ class Node:
             )
         self.priv_validator = priv_validator
 
-        # --- stores + state (node.go:136-156) --------------------------------
-        self.state_store = StateStore(MemDB())
-        self.block_store = BlockStore(MemDB())
+        # --- stores + state (node.go:136-156, initDBs) ------------------------
+        db_dir = os.path.join(config.home, "data") if config.home else ""
+        self._dbs = [open_db(config.db_backend, db_dir, n) for n in ("state", "blockstore")]
+        self.state_store = StateStore(self._dbs[0])
+        self.block_store = BlockStore(self._dbs[1])
         stored = self.state_store.load()
         if stored is None:
             self.sm_state = state_from_genesis(genesis)
@@ -136,7 +141,9 @@ class Node:
         if config.tx_index:
             from tendermint_tpu.indexer import KVIndexer
 
-            self.indexer = KVIndexer(MemDB())
+            idx_db = open_db(config.db_backend, db_dir, "tx_index")
+            self._dbs.append(idx_db)
+            self.indexer = KVIndexer(idx_db)
 
         # --- pools + executor (node.go:258-297) ------------------------------
         self.mempool = TxMempool(config.mempool, app_client)
@@ -152,6 +159,18 @@ class Node:
             evidence_pool=self.evidence_pool,
             event_publisher=self._fire_events,
         )
+
+        # --- ABCI handshake (node.go:422 -> replay.go:204-550) ----------------
+        # On restart, replay stored blocks into the app until its height
+        # matches the store (the app may have lost state or trail by one).
+        if stored is not None:
+            from tendermint_tpu.consensus.replay import Handshaker
+
+            handshaker = Handshaker(
+                self.state_store, self.block_store, self.block_exec, genesis
+            )
+            self.sm_state = handshaker.handshake(app_client, self.sm_state)
+            self.evidence_pool.set_state(self.sm_state)
 
         # --- p2p (node.go:206-256) -------------------------------------------
         if transport is None:
@@ -303,6 +322,11 @@ class Node:
             except Exception:
                 pass
         self.router.stop()
+        for db in getattr(self, "_dbs", []):
+            try:
+                db.close()
+            except Exception:
+                pass
         self._started = False
 
     def _fire_events(self, block, block_id, fres, validator_updates) -> None:

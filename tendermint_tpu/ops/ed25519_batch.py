@@ -238,6 +238,63 @@ def _compiled_kernel(n: int, backend: Optional[str]):
     return jax.jit(verify_kernel, backend=backend)
 
 
+# --- implementation dispatch (XLA graph vs Pallas kernel) -------------------
+#
+# The Pallas kernel (ops/pallas_verify.py) keeps every field-op
+# intermediate in VMEM; the XLA graph materializes them to HBM. On TPU
+# backends the Pallas path is the default; CPU stays on the XLA graph
+# (Pallas interpret mode is a test vehicle, far too slow for real
+# batches). TENDERMINT_TPU_VERIFY_IMPL=pallas|xla|auto overrides.
+
+_IMPL_ENV = "TENDERMINT_TPU_VERIFY_IMPL"
+_PALLAS_BROKEN = False  # sticky per-process fallback after a failure
+
+
+def _platform(backend: Optional[str]) -> str:
+    try:
+        if backend:
+            return jax.local_devices(backend=backend)[0].platform
+        return jax.default_backend()
+    except Exception:
+        return "unknown"
+
+
+def active_impl(backend: Optional[str] = None) -> str:
+    """Which verifier implementation verify_batch will dispatch to."""
+    import os
+
+    mode = os.environ.get(_IMPL_ENV, "auto").lower()
+    if mode == "xla" or _PALLAS_BROKEN:
+        return "xla"
+    if mode == "pallas":
+        return "pallas"
+    return "pallas" if _platform(backend) in ("tpu", "axon") else "xla"
+
+
+def _run_chunk(inputs: dict, lo: int, hi: int, backend: Optional[str]):
+    """Dispatch one padded chunk, preferring Pallas on TPU backends."""
+    global _PALLAS_BROKEN
+    args = (
+        jnp.asarray(inputs["pk"][lo:hi]),
+        jnp.asarray(inputs["r"][lo:hi]),
+        jnp.asarray(inputs["s"][lo:hi]),
+        jnp.asarray(inputs["k"][lo:hi]),
+    )
+    if active_impl(backend) == "pallas":
+        try:
+            from tendermint_tpu.ops import pallas_verify
+
+            return pallas_verify.compiled_verify(hi - lo)(*args)
+        except Exception as exc:  # compile/runtime failure -> XLA graph
+            _PALLAS_BROKEN = True
+            import warnings
+
+            warnings.warn(
+                f"pallas verifier failed ({exc!r}); falling back to XLA graph"
+            )
+    return _compiled_kernel(hi - lo, backend)(*args)
+
+
 # --- host-side preparation --------------------------------------------------
 
 
@@ -368,14 +425,6 @@ def verify_batch(
     outs = []
     for lo in range(0, m, CHUNK):
         hi = min(lo + CHUNK, m)
-        fn = _compiled_kernel(hi - lo, backend)
-        outs.append(
-            fn(
-                jnp.asarray(inputs["pk"][lo:hi]),
-                jnp.asarray(inputs["r"][lo:hi]),
-                jnp.asarray(inputs["s"][lo:hi]),
-                jnp.asarray(inputs["k"][lo:hi]),
-            )
-        )
+        outs.append(_run_chunk(inputs, lo, hi, backend))
     device_ok = np.concatenate([np.asarray(o) for o in outs])[:n]
     return list(np.logical_and(device_ok, host_ok))

@@ -82,7 +82,7 @@ class HTTPClient:
     def abci_query(self, path: str, data: bytes, height: int = 0, prove: bool = False):
         return self.call(
             "abci_query",
-            {"path": path, "data": data.hex(), "height": height, "prove": prove},
+            {"path": path, "data": "0x" + data.hex(), "height": height, "prove": prove},
         )
 
     def abci_info(self):

@@ -23,20 +23,21 @@ from tendermint_tpu.rpc.server import INVALID_PARAMS, RPCError
 
 
 def _to_bytes_param(v: Any) -> bytes:
-    """Accept hex ('0xAB' / bare hex) or base64 params (reference URI
-    and JSON clients use both)."""
+    """'0x'-prefixed hex or base64 (the reference URI convention: hex
+    MUST carry the 0x prefix so e.g. a 64-char tx hash is never
+    mis-parsed as base64 — rpc/jsonrpc/server http_uri_handler)."""
     if isinstance(v, bytes):
         return v
     if isinstance(v, str):
         if v.startswith("0x") or v.startswith("0X"):
-            return bytes.fromhex(v[2:])
+            try:
+                return bytes.fromhex(v[2:])
+            except ValueError:
+                raise RPCError(INVALID_PARAMS, f"invalid hex param: {v!r}")
         try:
             return base64.b64decode(v, validate=True)
         except Exception:
-            try:
-                return bytes.fromhex(v)
-            except ValueError:
-                raise RPCError(INVALID_PARAMS, f"cannot decode bytes param: {v!r}")
+            raise RPCError(INVALID_PARAMS, f"cannot decode bytes param: {v!r}")
     raise RPCError(INVALID_PARAMS, f"cannot decode bytes param: {v!r}")
 
 

@@ -128,7 +128,11 @@ class RPCServer:
         self._thread.start()
 
     def stop(self) -> None:
-        self._httpd.shutdown()
+        # shutdown() blocks forever unless serve_forever is running
+        # (BaseServer.__is_shut_down is only set by the serve loop), so a
+        # never-started server gets only server_close().
+        if self._thread is not None:
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=2)

@@ -463,20 +463,53 @@ def _evidence_to_abci(evidence: List[Evidence]) -> List[abci.Misbehavior]:
     return out
 
 
+def _events_to_json(events: List[abci.Event]) -> list:
+    return [
+        {
+            "type": e.type,
+            "attributes": [
+                {"key": a.key, "value": a.value, "index": a.index}
+                for a in e.attributes
+            ],
+        }
+        for e in events
+    ]
+
+
+def _events_from_json(data: list) -> List[abci.Event]:
+    return [
+        abci.Event(
+            type=e["type"],
+            attributes=[
+                abci.EventAttribute(
+                    key=a["key"], value=a["value"], index=a.get("index", False)
+                )
+                for a in e["attributes"]
+            ],
+        )
+        for e in data
+    ]
+
+
 def _unmarshal_finalize_response(raw: bytes) -> abci.ResponseFinalizeBlock:
-    """Inverse of _marshal_finalize_response (RPC /block_results reads
-    the persisted subset back; log/info/events are not retained)."""
+    """Inverse of _marshal_finalize_response (RPC /block_results and
+    index rebuilds read the full persisted response back)."""
     import json
 
     d = json.loads(raw.decode())
     return abci.ResponseFinalizeBlock(
         app_hash=bytes.fromhex(d["app_hash"]),
+        events=_events_from_json(d.get("events", [])),
         tx_results=[
             abci.ExecTxResult(
                 code=r["code"],
                 data=bytes.fromhex(r["data"]),
+                log=r.get("log", ""),
+                info=r.get("info", ""),
                 gas_wanted=r["gas_wanted"],
                 gas_used=r["gas_used"],
+                events=_events_from_json(r.get("events", [])),
+                codespace=r.get("codespace", ""),
             )
             for r in d["tx_results"]
         ],
@@ -492,18 +525,26 @@ def _unmarshal_finalize_response(raw: bytes) -> abci.ResponseFinalizeBlock:
 
 
 def _marshal_finalize_response(fres: abci.ResponseFinalizeBlock) -> bytes:
-    """Compact persistence of the FinalizeBlock response for replay."""
+    """Persistence of the FinalizeBlock response for replay, /block_results,
+    and index rebuilds (store.go SaveFinalizeBlockResponses). Events and
+    logs are retained — ABCI-event consumers (indexer/relayers) depend on
+    /block_results carrying them."""
     import json
 
     return json.dumps(
         {
             "app_hash": fres.app_hash.hex(),
+            "events": _events_to_json(fres.events),
             "tx_results": [
                 {
                     "code": r.code,
                     "data": r.data.hex(),
+                    "log": r.log,
+                    "info": r.info,
                     "gas_wanted": r.gas_wanted,
                     "gas_used": r.gas_used,
+                    "events": _events_to_json(r.events),
+                    "codespace": r.codespace,
                 }
                 for r in fres.tx_results
             ],

@@ -1,6 +1,36 @@
 """Persistence: key-value abstraction, block store, state store
 (reference: tm-db, internal/store/, internal/state/store.go)."""
 
+import os
+from typing import Optional
+
 from tendermint_tpu.storage.kv import Batch, KVStore, MemDB
 
-__all__ = ["Batch", "KVStore", "MemDB"]
+
+def open_db(backend: str, db_dir: str = "", name: str = "db") -> KVStore:
+    """Backend factory — the config/db.go:29 seam.
+
+    backends: "memdb" (default in tests), "filedb" (persistent,
+    C++ engine when it builds, pure-Python engine otherwise),
+    "filedb-py" / "filedb-c" to force an engine.
+    """
+    if backend == "memdb":
+        return MemDB()
+    if backend in ("filedb", "filedb-c", "filedb-py"):
+        if not db_dir:
+            raise ValueError(f"backend {backend!r} requires a db_dir")
+        path = os.path.join(db_dir, name + ".fdb")
+        if backend != "filedb-py":
+            from tendermint_tpu.storage import cfiledb
+
+            if cfiledb.available():
+                return cfiledb.CFileDB(path)
+            if backend == "filedb-c":
+                raise RuntimeError("native filedb engine unavailable")
+        from tendermint_tpu.storage.filedb import FileDB
+
+        return FileDB(path)
+    raise ValueError(f"unknown db backend {backend!r}")
+
+
+__all__ = ["Batch", "KVStore", "MemDB", "open_db"]

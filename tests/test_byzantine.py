@@ -1,0 +1,94 @@
+"""Byzantine behavior: an equivocating validator yields committed evidence.
+
+The in-process analog of internal/consensus/byzantine_test.go: one of
+four validators double-signs prevotes (same height/round, conflicting
+block IDs). Honest peers detect the conflict in their vote sets
+(types/vote_set.go conflicting-vote tracking), turn it into
+DuplicateVoteEvidence (evidence pool reportConflictingVotes), gossip
+it, and a later proposer commits it into a block.
+"""
+
+import time
+
+import pytest
+
+from tendermint_tpu.types.block import BlockID, Vote
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+from tests.test_node import fast_genesis, make_node, wait_for, four_privs  # noqa: F401
+from tendermint_tpu.p2p.transport import MemoryNetwork
+from tendermint_tpu.encoding.canonical import SIGNED_MSG_TYPE_PREVOTE
+
+
+def _make_equivocator(node, chain_id):
+    """Wrap the reactor's broadcast_vote: every non-nil prevote is paired
+    with a conflicting nil prevote signed by the same key (the
+    double-sign byzantine_test.go injects)."""
+    reactor = node.consensus_reactor
+    pv = node.priv_validator
+    orig = reactor.broadcast_vote
+
+    def byzantine_broadcast(vote: Vote) -> None:
+        orig(vote)
+        if vote.type == SIGNED_MSG_TYPE_PREVOTE and not vote.block_id.is_nil():
+            dup = Vote(
+                type=vote.type,
+                height=vote.height,
+                round=vote.round,
+                block_id=BlockID(),  # nil: conflicts with the real prevote
+                timestamp=vote.timestamp,
+                validator_address=vote.validator_address,
+                validator_index=vote.validator_index,
+            )
+            # Sign directly with the key, bypassing FilePV's double-sign
+            # guard — that guard is exactly what a byzantine node ignores.
+            dup.signature = pv.priv_key.sign(dup.sign_bytes(chain_id))
+            orig(dup)
+
+    reactor.broadcast_vote = byzantine_broadcast
+
+
+class TestByzantine:
+    def test_equivocating_prevoter_gets_evidenced(self, tmp_path, four_privs):
+        net = MemoryNetwork()
+        nodes = []
+        for i in range(4):
+            node, _ = make_node(tmp_path, f"node{i}", four_privs, index=i, net=net)
+            nodes.append(node)
+        for i, node in enumerate(nodes):
+            if i > 0:
+                node.config.persistent_peers = [
+                    f"{nodes[0].node_key.node_id}@node0"
+                ]
+        _make_equivocator(nodes[2], nodes[2].genesis.chain_id)
+        for node in nodes:
+            node.start()
+        try:
+            assert wait_for(
+                lambda: all(len(n.router.connected_peers()) >= 1 for n in nodes),
+                timeout=10,
+            ), "peers failed to connect"
+
+            byz_addr = four_privs[2].get_pub_key().address()
+
+            def committed_duplicate_vote_evidence():
+                for n in nodes:
+                    for h in range(1, n.height + 1):
+                        blk = n.block_store.load_block(h)
+                        if blk is None:
+                            continue
+                        for ev in blk.evidence:
+                            if (
+                                isinstance(ev, DuplicateVoteEvidence)
+                                and ev.vote_a.validator_address == byz_addr
+                            ):
+                                return True
+                return False
+
+            assert wait_for(committed_duplicate_vote_evidence, timeout=90), (
+                f"no DuplicateVoteEvidence committed; heights: "
+                f"{[n.height for n in nodes]}"
+            )
+        finally:
+            for node in nodes:
+                node.stop()

@@ -116,6 +116,10 @@ class TestEvidencePool:
         v1 = signed_vote(privs[1], vset, 1, height=5, block_id=make_block_id(b"a"))
         v2 = signed_vote(privs[1], vset, 1, height=5, block_id=make_block_id(b"b"))
         pool.report_conflicting_votes(v1, v2)
+        # Buffered until the next Update (the height must have committed
+        # before the evidence is verifiable — pool.go consensusBuffer).
+        assert len(pool.pending_evidence(-1)[0]) == 0
+        pool.update(pool.state, [])
         assert len(pool.pending_evidence(-1)[0]) == 1
 
     def test_expired_evidence_rejected_and_pruned(self):

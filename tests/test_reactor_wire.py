@@ -1,0 +1,69 @@
+"""Consensus reactor wire hygiene + handshake edge cases (review fixes)."""
+
+import struct
+
+import pytest
+
+from tendermint_tpu.consensus.reactor import (
+    MAX_WIRE_VALIDATORS,
+    TAG_VOTE_SET_BITS,
+    decode_vote_set_bits,
+    encode_vote_set_bits,
+)
+from tendermint_tpu.consensus.peer_state import PeerState
+from tendermint_tpu.libs.bits import BitArray
+from tendermint_tpu.storage.filedb import FileDB
+
+
+def test_vote_set_bits_roundtrip():
+    ba = BitArray(10)
+    ba.set_index(3, True)
+    ba.set_index(9, True)
+    msg = encode_vote_set_bits(7, 2, 1, ba)
+    assert msg[0] == TAG_VOTE_SET_BITS
+    h, r, t, got = decode_vote_set_bits(msg[1:])
+    assert (h, r, t) == (7, 2, 1)
+    assert [got.get_index(i) for i in range(10)] == [
+        ba.get_index(i) for i in range(10)
+    ]
+
+
+def test_vote_set_bits_rejects_hostile_sizes():
+    # Oversized nbits claim: would allocate ~256MB
+    payload = struct.pack(">qibi", 1, 0, 1, 2**31 - 1)
+    assert decode_vote_set_bits(payload) is None
+    # Truncated body: bits count exceeds backing storage
+    payload = struct.pack(">qibi", 1, 0, 1, 10000) + b"\x01"
+    assert decode_vote_set_bits(payload) is None
+    # Negative
+    payload = struct.pack(">qibi", 1, 0, 1, -5)
+    assert decode_vote_set_bits(payload) is None
+
+
+def test_peer_state_catchup_grows_with_late_commit():
+    """First catch-up call often sees no commit yet (n_vals=0); the
+    bitarrays must grow when the commit appears, not pin at size 0."""
+    ps = PeerState("p")
+    ps.ensure_catchup(5, 4, 0)
+    assert ps.catchup_commit.size() == 0
+    ps.catchup_parts.set_index(1, True)
+    ps.ensure_catchup(5, 4, 7)  # commit appeared with 7 signatures
+    assert ps.catchup_commit.size() == 7
+    assert ps.catchup_parts.get_index(1), "growth must preserve sent marks"
+    ps.catchup_commit.set_index(2, True)
+    ps.ensure_catchup(5, 4, 7)
+    assert ps.catchup_commit.get_index(2)
+    ps.ensure_catchup(6, 2, 3)  # height change resets
+    assert not ps.catchup_parts.get_index(1)
+
+
+def test_filedb_auto_compacts(tmp_path):
+    db = FileDB(str(tmp_path / "kv.fdb"))
+    db.COMPACT_MIN_GARBAGE = 16
+    import os
+
+    for i in range(200):
+        db.set(b"hot", str(i).encode())
+    assert db._garbage < 200, "auto-compaction never ran"
+    assert db.get(b"hot") == b"199"
+    db.close()
