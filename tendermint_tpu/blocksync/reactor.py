@@ -62,16 +62,24 @@ class BlockSyncReactor(PeerTransport):
 
     # --- lifecycle ------------------------------------------------------------
 
-    def start(self) -> None:
+    def start(self, start_syncer: bool = True) -> None:
+        """Serving always starts; pass start_syncer=False to delay the
+        client side (a state-syncing node block-syncs only after the
+        snapshot restore — node.go statesync -> bcReactor.SwitchToBlockSync)."""
         self._stop_flag.clear()
         t = threading.Thread(target=self._recv_loop, daemon=True)
         t.start()
         self._threads.append(t)
-        if self.syncer is not None:
-            t2 = threading.Thread(target=self._status_loop, daemon=True)
-            t2.start()
-            self._threads.append(t2)
-            self.syncer.start()
+        if start_syncer:
+            self.start_syncing()
+
+    def start_syncing(self) -> None:
+        if self.syncer is None:
+            return
+        t2 = threading.Thread(target=self._status_loop, daemon=True)
+        t2.start()
+        self._threads.append(t2)
+        self.syncer.start()
 
     def stop(self) -> None:
         self._stop_flag.set()

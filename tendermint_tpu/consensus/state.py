@@ -92,6 +92,7 @@ class ConsensusState:
         self.priv_pub_key = priv_validator.get_pub_key() if priv_validator else None
         self.wal = wal or NilWAL()
         self.broadcaster = broadcaster or Broadcaster()
+        self.event_bus = None  # set by the node (node.go wires eventbus)
         self._now = now or (lambda: Timestamp.from_unix_ns(_time.time_ns()))
         self.on_committed = on_committed
 
@@ -303,6 +304,28 @@ class ConsensusState:
 
     def _new_step(self) -> None:
         self.broadcaster.broadcast_new_round_step(self.rs)
+        self._publish_event(
+            "publish_event_new_round_step",
+            lambda eb: eb.EventDataRoundState(
+                height=self.rs.height,
+                round=self.rs.round,
+                step=self.rs.step.name,
+            ),
+        )
+
+    def _publish_event(self, publisher: str, build) -> None:
+        """Fire a consensus event onto the node's bus (state.go fires
+        NewRound/NewRoundStep/CompleteProposal/Vote via its eventbus).
+        The bus is optional — tests drive the SM without a node."""
+        bus = self.event_bus
+        if bus is None:
+            return
+        try:
+            from tendermint_tpu import eventbus as eb
+
+            getattr(bus, publisher)(build(eb))
+        except Exception:
+            pass
 
     def _schedule_round_0(self) -> None:
         delay = max(
@@ -337,6 +360,15 @@ class ConsensusState:
             rs.proposal_block_parts = None
         rs.votes.set_round(round_ + 1)  # track next round for round-skipping
         rs.triggered_timeout_precommit = False
+        self._publish_event(
+            "publish_event_new_round",
+            lambda eb: eb.EventDataNewRound(
+                height=height,
+                round=round_,
+                step=rs.step.name,
+                proposer_address=validators.get_proposer().address,
+            ),
+        )
         self._enter_propose(height, round_)
 
     def _enter_propose(self, height: int, round_: int) -> None:
@@ -726,6 +758,15 @@ class ConsensusState:
     def _handle_complete_proposal(self) -> None:
         """state.go handleCompleteProposal:2255-2287."""
         rs = self.rs
+        self._publish_event(
+            "publish_event_complete_proposal",
+            lambda eb: eb.EventDataCompleteProposal(
+                height=rs.height,
+                round=rs.round,
+                step=rs.step.name,
+                block_id=rs.proposal.block_id if rs.proposal else None,
+            ),
+        )
         prevotes = rs.votes.prevotes(rs.round)
         block_id, has_maj = (
             prevotes.two_thirds_majority() if prevotes else (BlockID(), False)
@@ -804,6 +845,9 @@ class ConsensusState:
         # their gossip routines skip re-sending (reactor HasVote flow).
         self.broadcaster.broadcast_has_vote(
             vote.height, vote.round, vote.type, vote.validator_index
+        )
+        self._publish_event(
+            "publish_event_vote", lambda eb: eb.EventDataVote(vote=vote)
         )
 
         if vote.type == SIGNED_MSG_TYPE_PREVOTE:

@@ -115,6 +115,12 @@ class EventDataBlockSyncStatus:
     height: int
 
 
+@dataclass
+class EventDataStateSyncStatus:
+    complete: bool
+    height: int
+
+
 def _abci_events_to_map(events: List[abci.Event], into: Events) -> None:
     """Flatten ABCI events to composite keys (reference events.go)."""
     for ev in events or []:
@@ -202,6 +208,9 @@ class EventBus:
 
     def publish_event_block_sync_status(self, data: EventDataBlockSyncStatus) -> None:
         self._publish(EVENT_BLOCK_SYNC_STATUS, data)
+
+    def publish_event_state_sync_status(self, data: EventDataStateSyncStatus) -> None:
+        self._publish(EVENT_STATE_SYNC_STATUS, data)
 
 
 @dataclass

@@ -39,6 +39,7 @@ from tendermint_tpu.p2p.transport import (
 from tendermint_tpu.privval import FilePV
 from tendermint_tpu.privval.base import PrivValidator
 from tendermint_tpu.state import StateStore, state_from_genesis
+from tendermint_tpu.statesync import StateSyncConfig, StateSyncReactor, StateSyncer
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.storage import open_db
 from tendermint_tpu.storage.blockstore import BlockStore
@@ -63,6 +64,8 @@ class NodeConfig:
     # tm-db backend selection (config/db.go:29): "memdb" or "filedb".
     # filedb requires `home` (data lands in <home>/data/*.fdb).
     db_backend: str = "memdb"
+    # State sync (config/config.go StateSyncConfig): None disables.
+    statesync: Optional["StateSyncConfig"] = None
 
 
 class Node:
@@ -205,6 +208,7 @@ class Node:
             priv_validator=self.priv_validator,
             wal=wal,
         )
+        self.consensus.event_bus = self.event_bus
         self.consensus_reactor = ConsensusReactor(self.consensus, self.router)
         self.mempool_reactor = MempoolReactor(self.mempool, self.router)
         self.evidence_reactor = EvidenceReactor(self.evidence_pool, self.router)
@@ -225,6 +229,28 @@ class Node:
             self.syncer, self.block_store, self.router
         )
         self.pex_reactor = PexReactor(self.peer_manager, self.router)
+
+        # --- statesync (node.go:358-388) --------------------------------------
+        # The reactor always runs (every node serves snapshots/light blocks);
+        # the syncer only on fresh nodes with statesync enabled.
+        self.statesync_reactor = StateSyncReactor(
+            self.router, app_client, self.block_store, self.state_store
+        )
+        self.statesyncer = None
+        self.statesync_error = None
+        if (
+            config.statesync is not None
+            and config.statesync.enabled
+            and self.sm_state.last_block_height == 0
+        ):
+            self.statesyncer = StateSyncer(
+                self.statesync_reactor,
+                app_client,
+                self.state_store,
+                self.block_store,
+                genesis,
+                config.statesync,
+            )
 
         # --- RPC (node.go:512, internal/rpc/core) ----------------------------
         self.rpc_server = None
@@ -247,6 +273,7 @@ class Node:
                 peer_manager=self.peer_manager,
                 get_state=lambda: self.consensus.state,
                 is_syncing=lambda: not self._caught_up_event.is_set(),
+                consensus_reactor=self.consensus_reactor,
             )
             self.rpc_env = env
             self.rpc_server = RPCServer(
@@ -263,10 +290,15 @@ class Node:
         self.evidence_reactor.start()
         self.mempool_reactor.start()
         self.consensus_reactor.start()
-        self.blocksync_reactor.start()
+        self.statesync_reactor.start()
+        self.blocksync_reactor.start(start_syncer=self.statesyncer is None)
         for peer in self.config.persistent_peers:
             self.peer_manager.add_address(PeerAddress.parse(peer), persistent=True)
-        if self.syncer is None:
+        if self.statesyncer is not None:
+            threading.Thread(
+                target=self._statesync_then_blocksync, daemon=True
+            ).start()
+        elif self.syncer is None:
             self._switch_to_consensus(self.sm_state)
         else:
             # If there's nothing to sync from within a grace period, start
@@ -277,6 +309,56 @@ class Node:
         if self.rpc_server is not None:
             self.rpc_server.start()
         self._started = True
+
+    def _statesync_then_blocksync(self) -> None:
+        """node.go:358-388: snapshot restore, then block sync from the
+        restored height, then consensus. Statesync failure degrades to
+        plain block sync from genesis."""
+        from tendermint_tpu.statesync.syncer import StateSyncFatalError
+
+        try:
+            state = self.statesyncer.sync()
+            self.event_bus.publish_event_state_sync_status(
+                events_mod.EventDataStateSyncStatus(
+                    complete=True, height=state.last_block_height
+                )
+            )
+            self.sm_state = state
+            self.evidence_pool.set_state(state)
+            if self.syncer is not None:
+                from tendermint_tpu.blocksync.pool import BlockPool
+
+                self.syncer.state = state
+                self.syncer.pool = BlockPool(state.last_block_height + 1)
+        except StateSyncFatalError as exc:
+            # The app already holds restored state: block-syncing from
+            # genesis on top of it would produce wrong app hashes. Halt
+            # sync instead of degrading (the reference treats this as
+            # fatal at node startup).
+            self.statesync_error = exc
+            self.event_bus.publish_event_state_sync_status(
+                events_mod.EventDataStateSyncStatus(complete=False, height=0)
+            )
+            import warnings
+
+            warnings.warn(f"state sync failed fatally; node halted: {exc}")
+            return
+        except Exception as exc:
+            # Pre-restore failure (no snapshots, bad anchor, no peers):
+            # the app is untouched, so degrading to a full block sync
+            # from the current state is sound.
+            self.statesync_error = exc
+            self.event_bus.publish_event_state_sync_status(
+                events_mod.EventDataStateSyncStatus(complete=False, height=0)
+            )
+            import warnings
+
+            warnings.warn(f"state sync failed; falling back to block sync: {exc}")
+        if self.syncer is None:
+            self._switch_to_consensus(self.sm_state)
+            return
+        self.blocksync_reactor.start_syncing()
+        self._blocksync_grace()
 
     def _blocksync_grace(self) -> None:
         deadline = _time.monotonic() + 2.0
@@ -296,8 +378,17 @@ class Node:
         self._caught_up_event.set()
         if self.syncer is not None:
             self.syncer.stop()
-            # Adopt the synced state.
-            self.consensus._reconstruct_and_update(self.syncer.state)
+            state = self.syncer.state  # adopt the synced state
+        if (
+            self.syncer is not None
+            or state.last_block_height > self.consensus.state.last_block_height
+        ):
+            self.consensus._reconstruct_and_update(state)
+        self.event_bus.publish_event_block_sync_status(
+            events_mod.EventDataBlockSyncStatus(
+                complete=True, height=state.last_block_height
+            )
+        )
         self.consensus.start()
 
     def stop(self) -> None:
@@ -312,6 +403,7 @@ class Node:
             pass
         for r in (
             self.blocksync_reactor,
+            self.statesync_reactor,
             self.consensus_reactor,
             self.mempool_reactor,
             self.evidence_reactor,

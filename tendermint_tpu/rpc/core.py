@@ -60,6 +60,7 @@ class Environment:
         peer_manager=None,
         get_state: Optional[Callable] = None,
         is_syncing: Optional[Callable[[], bool]] = None,
+        consensus_reactor=None,
     ):
         self.node_info = node_info
         self.genesis = genesis
@@ -74,6 +75,7 @@ class Environment:
         self.peer_manager = peer_manager
         self.get_state = get_state or (lambda: None)
         self.is_syncing = is_syncing or (lambda: False)
+        self.consensus_reactor = consensus_reactor
 
     # -- route table ----------------------------------------------------------
 
@@ -93,7 +95,7 @@ class Environment:
             "header_by_hash": self.header_by_hash,
             "validators": self.validators,
             "consensus_state": self.consensus_state,
-            "dump_consensus_state": self.consensus_state,
+            "dump_consensus_state": self.dump_consensus_state,
             "consensus_params": self.consensus_params,
             "unconfirmed_txs": self.unconfirmed_txs,
             "num_unconfirmed_txs": self.num_unconfirmed_txs,
@@ -344,19 +346,100 @@ class Environment:
         }
 
     def consensus_state(self) -> Dict[str, Any]:
+        """internal/rpc/core/consensus.go GetConsensusState: the round
+        state summary."""
         cs = self.consensus
-        if cs is None:
-            return {"round_state": None}
-        rs = getattr(cs, "rs", None)
+        rs = getattr(cs, "rs", None) if cs is not None else None
         if rs is None:
             return {"round_state": None}
         return {
             "round_state": {
+                "height/round/step": rs.height_round_step(),
                 "height": str(rs.height),
                 "round": rs.round,
                 "step": rs.step.name,
+                "start_time": str(rs.start_time.to_unix_ns()),
+                "proposal_block_hash": enc.hex_bytes(
+                    rs.proposal_block.hash() if rs.proposal_block is not None else b""
+                ),
+                "locked_block_hash": enc.hex_bytes(
+                    rs.locked_block.hash() if rs.locked_block is not None else b""
+                ),
+                "valid_block_hash": enc.hex_bytes(
+                    rs.valid_block.hash() if rs.valid_block is not None else b""
+                ),
+                "height_vote_set": self._height_vote_set_json(rs),
             }
         }
+
+    @staticmethod
+    def _bits(ba) -> str:
+        if ba is None:
+            return ""
+        return "".join("x" if ba.get_index(i) else "_" for i in range(ba.size()))
+
+    def _height_vote_set_json(self, rs) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        if rs.votes is None:
+            return out
+        for r in range(rs.round + 1):
+            prevotes = rs.votes.prevotes(r)
+            precommits = rs.votes.precommits(r)
+            out.append(
+                {
+                    "round": r,
+                    "prevotes_bit_array": self._bits(
+                        prevotes.bit_array() if prevotes else None
+                    ),
+                    "precommits_bit_array": self._bits(
+                        precommits.bit_array() if precommits else None
+                    ),
+                }
+            )
+        return out
+
+    def dump_consensus_state(self) -> Dict[str, Any]:
+        """internal/rpc/core/consensus.go DumpConsensusState: full round
+        state + per-peer round states from the reactor's PeerStates."""
+        base = self.consensus_state()
+        cs = self.consensus
+        rs = getattr(cs, "rs", None) if cs is not None else None
+        if rs is not None:
+            base["round_state"]["validators"] = {
+                "proposer": enc.hex_bytes(
+                    rs.validators.get_proposer().address
+                    if rs.validators is not None and not rs.validators.is_nil_or_empty()
+                    else b""
+                ),
+                "count": len(rs.validators) if rs.validators is not None else 0,
+            }
+            base["round_state"]["last_commit_bit_array"] = self._bits(
+                rs.last_commit.bit_array() if rs.last_commit is not None else None
+            )
+        peers = []
+        reactor = self.consensus_reactor
+        if reactor is not None:
+            with reactor._peers_mtx:
+                peer_states = dict(reactor._peers)
+            for pid, ps in sorted(peer_states.items()):
+                height, round_, step, lcr = ps.snapshot()
+                peers.append(
+                    {
+                        "node_address": pid,
+                        "peer_state": {
+                            "round_state": {
+                                "height": str(height),
+                                "round": round_,
+                                "step": step,
+                                "last_commit_round": lcr,
+                                "has_proposal": ps.has_proposal,
+                                "proposal_block_parts": self._bits(ps.parts),
+                            }
+                        },
+                    }
+                )
+        base["peers"] = peers
+        return base
 
     # -- mempool routes -------------------------------------------------------
 
@@ -619,4 +702,36 @@ def _event_data_json(data: object) -> Dict[str, Any]:
         }
     if isinstance(data, eb.EventDataValidatorSetUpdates):
         return {"type": "validator_set_updates"}
+    if isinstance(data, eb.EventDataVote):
+        v = data.vote
+        return {
+            "type": "vote",
+            "height": str(v.height),
+            "round": v.round,
+            "vote_type": v.type,
+            "validator_address": enc.hex_bytes(v.validator_address),
+            "validator_index": v.validator_index,
+        }
+    if isinstance(data, eb.EventDataCompleteProposal):
+        return {
+            "type": "complete_proposal",
+            "height": str(data.height),
+            "round": data.round,
+            "step": data.step,
+            "block_hash": enc.hex_bytes(data.block_id.hash)
+            if data.block_id is not None
+            else "",
+        }
+    if isinstance(data, eb.EventDataBlockSyncStatus):
+        return {
+            "type": "block_sync_status",
+            "complete": data.complete,
+            "height": str(data.height),
+        }
+    if isinstance(data, eb.EventDataStateSyncStatus):
+        return {
+            "type": "state_sync_status",
+            "complete": data.complete,
+            "height": str(data.height),
+        }
     return {"type": type(data).__name__}

@@ -186,13 +186,13 @@ class StateStore:
         self._db.set(_state_key(), _encode_state(state))
 
     def bootstrap(self, state: State) -> None:
-        """store.go Bootstrap (statesync entry)."""
+        """store.go Bootstrap (statesync entry): full valset records at
+        h (last), h+1 (current), h+2 (next) so commit verification and
+        ABCI CommitInfo construction work from the restored height."""
         height = state.last_block_height + 1
-        if height == state.initial_height and state.last_validators is not None \
+        if state.last_validators is not None \
                 and not state.last_validators.is_nil_or_empty():
             self._save_validators(height - 1, height - 1, state.last_validators)
-        if height == state.initial_height:
-            height = state.initial_height
         self._save_validators(height, height, state.validators)
         self._save_validators(
             height + 1, height + 1, state.next_validators

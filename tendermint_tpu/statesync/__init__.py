@@ -1,0 +1,7 @@
+"""State sync: bootstrap a fresh node from an application snapshot
+(reference: internal/statesync/ — reactor, syncer, state provider)."""
+
+from tendermint_tpu.statesync.reactor import StateSyncReactor
+from tendermint_tpu.statesync.syncer import StateSyncer, StateSyncConfig
+
+__all__ = ["StateSyncReactor", "StateSyncer", "StateSyncConfig"]

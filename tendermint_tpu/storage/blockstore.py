@@ -165,6 +165,12 @@ class BlockStore:
         raw = self._db.get(_commit_key(height))
         return Commit.from_proto_bytes(raw) if raw is not None else None
 
+    def save_seen_commit(self, commit: Commit) -> None:
+        """Store the commit for the current tip without a block — the
+        statesync bootstrap path (store.go SaveSeenCommit), so consensus
+        can reconstruct its last commit after the jump."""
+        self._db.set(_seen_commit_key(), commit.to_proto_bytes())
+
     def load_seen_commit(self) -> Optional[Commit]:
         raw = self._db.get(_seen_commit_key())
         return Commit.from_proto_bytes(raw) if raw is not None else None

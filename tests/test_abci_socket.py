@@ -1,0 +1,160 @@
+"""ABCI socket transport: out-of-process apps (socket client/server).
+
+The reference's socket transport tier (abci/client/socket_client.go,
+abci/server/socket_server.go): the kvstore app runs as a SEPARATE OS
+PROCESS; the node drives it over TCP. The crash-restart case kills the
+app process and restarts it empty — the handshake must replay the chain
+back into it (replay.go:204-550).
+"""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.socket_client import SocketClient
+from tendermint_tpu.abci.socket_server import SocketServer
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.node import Node, NodeConfig
+from tendermint_tpu.privval import FilePV
+
+from tests.test_node import fast_genesis, wait_for
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn_app_process(tmp_path, db=""):
+    """Run the kvstore ABCI server as a real OS process."""
+    cmd = [
+        sys.executable,
+        "-m",
+        "tendermint_tpu.abci.socket_server",
+        "--addr",
+        "127.0.0.1:0",
+        "--app",
+        "kvstore",
+    ]
+    if db:
+        cmd += ["--db", db]
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env
+    )
+    line = proc.stdout.readline()
+    m = re.search(r"listening on ([\d.]+):(\d+)", line)
+    assert m, f"no listen line: {line!r}"
+    return proc, (m.group(1), int(m.group(2)))
+
+
+class TestSocketTransport:
+    def test_roundtrip_all_methods_in_process(self):
+        server = SocketServer(KVStoreApplication(snapshot_interval=1))
+        server.start()
+        try:
+            host, port = server.address
+            client = SocketClient(host, port)
+            client.start()
+            assert client.echo("ping") == "ping"
+            info = client.info(abci.RequestInfo())
+            assert info.last_block_height == 0
+            fres = client.finalize_block(
+                abci.RequestFinalizeBlock(height=1, txs=[b"a=1", b"b=2"])
+            )
+            assert [r.code for r in fres.tx_results] == [0, 0]
+            assert fres.app_hash
+            client.commit()
+            info = client.info(abci.RequestInfo())
+            assert info.last_block_height == 1
+            assert info.last_block_app_hash == fres.app_hash
+            q = client.query(abci.RequestQuery(path="/key", data=b"a"))
+            assert q.value == b"1"
+            snaps = client.list_snapshots(abci.RequestListSnapshots())
+            assert [s.height for s in snaps.snapshots] == [1]
+            chunk = client.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(height=1, format=1, chunk=0)
+            )
+            assert chunk.chunk
+            client.stop()
+        finally:
+            server.stop()
+
+    def test_app_error_surfaces_not_kills_connection(self):
+        class Exploding(KVStoreApplication):
+            def query(self, req):
+                raise RuntimeError("boom")
+
+        server = SocketServer(Exploding())
+        server.start()
+        try:
+            host, port = server.address
+            client = SocketClient(host, port)
+            client.start()
+            with pytest.raises(RuntimeError, match="boom"):
+                client.query(abci.RequestQuery(path="/key", data=b"x"))
+            assert client.echo("still-alive") == "still-alive"
+            client.stop()
+        finally:
+            server.stop()
+
+
+class TestOutOfProcessNode:
+    def _make_node(self, home, privs, client):
+        os.makedirs(home, exist_ok=True)
+        cfg = NodeConfig(
+            chain_id="node-chain",
+            home=home,
+            blocksync=False,
+            wal_enabled=True,
+            db_backend="filedb",
+        )
+        return Node(cfg, fast_genesis(privs), client, priv_validator=privs[0])
+
+    def test_node_commits_against_external_app_and_replays_after_kill(
+        self, tmp_path
+    ):
+        home = str(tmp_path / "home")
+        os.makedirs(home)
+        privs = [FilePV.generate(home + "/pk.json", home + "/ps.json")]
+
+        proc, (host, port) = spawn_app_process(tmp_path)
+        node = None
+        try:
+            client = SocketClient(host, port)
+            node = self._make_node(home, privs, client)
+            node.start()
+            node.submit_tx(b"color=red")
+            assert wait_for(lambda: node.height >= 3, timeout=60), node.height
+            h1 = node.height
+            node.consensus.priv_validator = None
+            node.stop()
+            client.stop()
+        finally:
+            if node is not None and node._started:
+                node.stop()
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+
+        # App process is dead and its state is gone. A fresh app process
+        # starts at height 0; the node handshake must replay it forward.
+        proc2, (host2, port2) = spawn_app_process(tmp_path)
+        node2 = None
+        try:
+            client2 = SocketClient(host2, port2)
+            node2 = self._make_node(home, privs, client2)
+            info = client2.info(abci.RequestInfo())
+            assert info.last_block_height == node2.sm_state.last_block_height >= h1
+            q = client2.query(abci.RequestQuery(path="/key", data=b"color"))
+            assert q.value == b"red", "replayed app lost the committed tx"
+            node2.start()
+            assert wait_for(lambda: node2.height >= h1 + 2, timeout=60), node2.height
+        finally:
+            if node2 is not None:
+                node2.consensus.priv_validator = None
+                node2.stop()
+            proc2.send_signal(signal.SIGKILL)
+            proc2.wait(timeout=10)

@@ -228,6 +228,38 @@ class TestRPCServer:
         blocks = client.block_search(f"tm.event = 'NewBlock' AND block.height = {height}")
         assert int(blocks["total_count"]) == 1
 
+    def test_events_observe_full_round_lifecycle(self, rpc_node):
+        """An /events subscriber sees the consensus round unfold: NewRound,
+        NewRoundStep, CompleteProposal, Vote, then NewBlock — the events
+        internal/consensus/state.go fires via its eventbus."""
+        node, client = rpc_node
+        h0 = node.height
+        assert wait_for(lambda: node.height >= h0 + 2, timeout=30)
+        seen = set()
+        after = 0
+        deadline = time.monotonic() + 20
+        want = {"NewRound", "NewRoundStep", "CompleteProposal", "Vote", "NewBlock"}
+        while time.monotonic() < deadline and not want <= seen:
+            ev = client.events(after=after, wait_time=2.0, max_items=200)
+            for item in ev["items"]:
+                seen.add(item["event"])
+            after = int(ev["newest"])
+        assert want <= seen, f"missing events: {want - seen}"
+
+    def test_dump_consensus_state_full(self, rpc_node):
+        node, client = rpc_node
+        dump = client.call("dump_consensus_state")
+        rs = dump["round_state"]
+        assert "height/round/step" in rs
+        assert "height_vote_set" in rs
+        assert "validators" in rs and rs["validators"]["count"] == 1
+        assert "peers" in dump  # empty list on a single node
+        # the prevote/precommit bitmaps reflect the single validator
+        assert all(
+            set(r["prevotes_bit_array"]) <= {"x", "_"}
+            for r in rs["height_vote_set"]
+        )
+
     def test_events_longpoll(self, rpc_node):
         node, client = rpc_node
         ev = client.events(query="tm.event = 'NewBlock'", wait_time=10.0)
