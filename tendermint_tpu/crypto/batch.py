@@ -87,10 +87,11 @@ class Ed25519BatchVerifier(BatchVerifier):
 
 
 def supports_batch_verifier(pub_key: Optional[PubKey]) -> bool:
-    """crypto/batch/batch.go:26-33. sr25519 will join once its verifier
-    lands — advertising it now would route callers into a fail-closed
-    all-False verdict instead of the single-verify fallback."""
-    return pub_key is not None and pub_key.type == ED25519_KEY_TYPE
+    """crypto/batch/batch.go:26-33: ed25519 and sr25519 batch."""
+    return pub_key is not None and pub_key.type in (
+        ED25519_KEY_TYPE,
+        SR25519_KEY_TYPE,
+    )
 
 
 def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:

@@ -306,4 +306,8 @@ def privkey_from_type_and_bytes(key_type: str, data: bytes) -> PrivKey:
         return Ed25519PrivKey(data)
     if key_type == SECP256K1_KEY_TYPE:
         return Secp256k1PrivKey(data)
+    if key_type == SR25519_KEY_TYPE:
+        from tendermint_tpu.crypto.sr25519 import Sr25519PrivKey
+
+        return Sr25519PrivKey(data)
     raise ValueError(f"unknown key type {key_type}")

@@ -132,7 +132,15 @@ def _verify_commit_batch(
     count_all_signatures: bool,
     look_up_by_index: bool,
 ) -> None:
-    """validation.go:151-258."""
+    """validation.go:151-258.
+
+    Divergence: on a mixed-key-type commit (e.g. ed25519 proposer but
+    sr25519 validators in the set), ``bv.add`` rejects the foreign key and
+    we fall back to single verification — which is what the reference's
+    own comment declares (validation.go:49-50 "if verification failed or
+    is not supported then fallback to single verification") but its code
+    never does (the Add error propagates and the commit fails).
+    """
     tallied = 0
     seen_vals = {}
     batch_sig_idxs = []
@@ -153,7 +161,19 @@ def _verify_commit_batch(
                 )
             seen_vals[val_idx] = idx
         vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
-        bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
+        try:
+            bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
+        except ValueError:
+            return _verify_commit_single(
+                chain_id,
+                vals,
+                commit,
+                voting_power_needed,
+                ignore_sig,
+                count_sig,
+                count_all_signatures,
+                look_up_by_index,
+            )
         batch_sig_idxs.append(idx)
         if count_sig(commit_sig):
             tallied += val.voting_power

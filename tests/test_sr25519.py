@@ -1,0 +1,249 @@
+"""sr25519 (Schnorrkel/ristretto255/Merlin) tests.
+
+Covers: the Merlin transcript against merlin's own published test vector,
+ristretto255 against the RFC 9496 generator-multiple vectors, schnorrkel
+key derivation against the polkadot-js wasm-crypto known pair, sign/verify
+semantics from the reference (crypto/sr25519/sr25519_test.go), batch
+verification (crypto/sr25519/batch.go:15-47), and mixed-curve commit
+verification (BASELINE.md config 5).
+"""
+
+import os
+
+import pytest
+
+from tendermint_tpu.crypto import sr25519
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.crypto.merlin import MerlinTranscript
+from tendermint_tpu.crypto.ristretto import (
+    B_POINT,
+    compress,
+    decompress,
+    equals,
+    pt_mul,
+)
+from tendermint_tpu.crypto.ed25519_ref import IDENT
+from tendermint_tpu.types import Validator, ValidatorSet
+from tendermint_tpu.types.validation import verify_commit
+from tests.helpers import CHAIN_ID, make_block_id, make_commit
+
+
+class TestMerlin:
+    def test_published_vector(self):
+        # merlin's transcript equivalence test (tests in merlin's
+        # transcript.rs): protocol "test protocol", one message, one
+        # 32-byte challenge.
+        t = MerlinTranscript(b"test protocol")
+        t.append_message(b"some label", b"some data")
+        c = t.challenge_bytes(b"challenge", 32)
+        assert c.hex() == (
+            "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+        )
+
+    def test_transcript_binding(self):
+        # any difference in label or data changes every later challenge
+        t1 = MerlinTranscript(b"proto")
+        t2 = MerlinTranscript(b"proto")
+        t1.append_message(b"a", b"x")
+        t2.append_message(b"a", b"y")
+        assert t1.challenge_bytes(b"c", 16) != t2.challenge_bytes(b"c", 16)
+
+    def test_challenge_advances_state(self):
+        t = MerlinTranscript(b"proto")
+        assert t.challenge_bytes(b"c", 32) != t.challenge_bytes(b"c", 32)
+
+    def test_clone_isolated(self):
+        t = MerlinTranscript(b"proto")
+        c = t.clone()
+        t.append_message(b"a", b"x")
+        c.append_message(b"a", b"x")
+        assert t.challenge_bytes(b"c", 32) == c.challenge_bytes(b"c", 32)
+
+
+class TestRistretto:
+    # RFC 9496 §A.1: encodings of B, 2B, ..., 5B
+    SMALL_MULTIPLES = [
+        "0000000000000000000000000000000000000000000000000000000000000000",
+        "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+        "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+        "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+        "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+    ]
+
+    def test_generator_multiples(self):
+        assert compress(IDENT).hex() == self.SMALL_MULTIPLES[0]
+        for k in range(1, len(self.SMALL_MULTIPLES)):
+            assert compress(pt_mul(k, B_POINT)).hex() == self.SMALL_MULTIPLES[k]
+
+    def test_roundtrip(self):
+        for k in range(1, 32):
+            p = pt_mul(k, B_POINT)
+            d = decompress(compress(p))
+            assert d is not None and equals(d, p)
+
+    def test_invalid_encodings_rejected(self):
+        # RFC 9496 §A.3: non-canonical / negative / invalid encodings
+        bad = [
+            # s = p (non-canonical zero)
+            "edffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+            # s = p - 1 (negative)
+            "ecffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff7f",
+            # negative s (low bit set)
+            "0100000000000000000000000000000000000000000000000000000000000000",
+        ]
+        for h in bad:
+            assert decompress(bytes.fromhex(h)) is None
+        assert decompress(b"\x00" * 31) is None  # wrong length
+
+
+class TestSchnorrkel:
+    def test_known_keypair(self):
+        # polkadot-js wasm-crypto known pair (seed -> public key); pins
+        # ExpandEd25519 (sha512 + clamp + /8) and ristretto compression.
+        seed = bytes.fromhex(
+            "fac7959dbfe72f052e5a0c3c8d6530f202b02fd8f9f5ca3580ec8deb7797479e"
+        )
+        assert sr25519.pubkey_from_seed(seed).hex() == (
+            "46ebddef8cd9bb167dc30878d7113b7e168e6f0646beffd77d69d39bad76b47a"
+        )
+
+    def test_sign_verify_roundtrip(self):
+        priv = sr25519.Sr25519PrivKey.generate()
+        pub = priv.pub_key()
+        msg = b"tendermint sr25519 message"
+        sig = priv.sign(msg)
+        assert len(sig) == 64
+        assert sig[63] & 0x80  # schnorrkel marker bit
+        assert pub.verify_signature(msg, sig)
+        assert not pub.verify_signature(msg + b"!", sig)
+        assert not pub.verify_signature(b"", sig)
+
+    def test_wrong_key_rejects(self):
+        a = sr25519.Sr25519PrivKey.generate()
+        b = sr25519.Sr25519PrivKey.generate()
+        sig = a.sign(b"msg")
+        assert not b.pub_key().verify_signature(b"msg", sig)
+
+    def test_marker_bit_required(self):
+        priv = sr25519.Sr25519PrivKey.generate()
+        sig = bytearray(priv.sign(b"msg"))
+        sig[63] &= 0x7F  # strip the schnorrkel marker
+        assert not priv.pub_key().verify_signature(b"msg", bytes(sig))
+
+    def test_mutated_signature_rejected(self):
+        priv = sr25519.Sr25519PrivKey.generate()
+        msg = b"msg"
+        sig = priv.sign(msg)
+        for i in (0, 10, 31, 32, 45, 62):
+            bad = bytearray(sig)
+            bad[i] ^= 0x01
+            assert not priv.pub_key().verify_signature(msg, bytes(bad))
+
+    def test_non_canonical_scalar_rejected(self):
+        priv = sr25519.Sr25519PrivKey.generate()
+        sig = bytearray(priv.sign(b"msg"))
+        # force s >= L while keeping the marker
+        sig[32:64] = b"\xff" * 32
+        assert not priv.pub_key().verify_signature(b"msg", bytes(sig))
+
+    def test_from_secret_deterministic(self):
+        a = sr25519.Sr25519PrivKey.from_secret(b"some secret")
+        b = sr25519.Sr25519PrivKey.from_secret(b"some secret")
+        assert a.bytes() == b.bytes()
+        assert a.pub_key().bytes() == b.pub_key().bytes()
+
+    def test_privkey_loadable_by_type(self):
+        # privval key files carry (type, bytes); the loader must route
+        # sr25519 to Sr25519PrivKey
+        from tendermint_tpu.crypto.keys import privkey_from_type_and_bytes
+
+        seed = bytes(range(32))
+        pk = privkey_from_type_and_bytes("sr25519", seed)
+        assert pk.type == "sr25519"
+        assert pk.pub_key().verify_signature(b"m", pk.sign(b"m"))
+
+    def test_pubkey_type_and_address(self):
+        pub = sr25519.Sr25519PrivKey.generate().pub_key()
+        assert pub.type == "sr25519"
+        assert len(pub.address()) == 20
+
+    def test_invalid_pubkey_fails_closed(self):
+        # negative field element cannot decompress; verify must return
+        # False, not raise (reachable from wire input)
+        bad_pub = sr25519.Sr25519PubKey(b"\x01" + b"\x00" * 31)
+        assert not bad_pub.verify_signature(b"msg", b"\x00" * 64)
+
+
+class TestBatch:
+    def test_batch_all_valid(self):
+        bv = sr25519.Sr25519BatchVerifier()
+        for i in range(16):
+            priv = sr25519.Sr25519PrivKey(os.urandom(32))
+            msg = b"message %d" % i
+            bv.add(priv.pub_key(), msg, priv.sign(msg))
+        ok, oks = bv.verify()
+        assert ok and all(oks) and len(oks) == 16
+
+    def test_batch_attributes_bad_entry(self):
+        bv = sr25519.Sr25519BatchVerifier()
+        privs = [sr25519.Sr25519PrivKey(os.urandom(32)) for _ in range(6)]
+        for i, priv in enumerate(privs):
+            msg = b"m%d" % i
+            sig = priv.sign(msg)
+            if i == 3:
+                msg = b"tampered"
+            bv.add(priv.pub_key(), msg, sig)
+        ok, oks = bv.verify()
+        assert not ok
+        assert oks == [True, True, True, False, True, True]
+
+    def test_batch_rejects_foreign_key(self):
+        bv = sr25519.Sr25519BatchVerifier()
+        ed = Ed25519PrivKey.generate()
+        with pytest.raises(ValueError):
+            bv.add(ed.pub_key(), b"m", b"\x00" * 64)
+
+    def test_empty_batch_fails(self):
+        ok, oks = sr25519.Sr25519BatchVerifier().verify()
+        assert not ok and oks == []
+
+
+class TestMixedCurveCommit:
+    def test_sr25519_only_commit(self):
+        privs = [sr25519.Sr25519PrivKey(bytes([i]) * 32) for i in range(4)]
+        vset = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+        by_addr = {p.pub_key().address(): p for p in privs}
+        privs_sorted = [by_addr[v.address] for v in vset.validators]
+        bid = make_block_id()
+        commit = make_commit(bid, 5, 0, vset, privs_sorted)
+        verify_commit(CHAIN_ID, vset, bid, 5, commit)  # must not raise
+
+    def test_mixed_ed25519_sr25519_commit(self):
+        """BASELINE.md config 5: a commit whose validator set mixes key
+        types verifies (batch add falls back to single verification)."""
+        privs = [
+            Ed25519PrivKey.from_seed(bytes([i]) * 32) if i % 2 == 0
+            else sr25519.Sr25519PrivKey(bytes([i]) * 32)
+            for i in range(6)
+        ]
+        vset = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+        by_addr = {p.pub_key().address(): p for p in privs}
+        privs_sorted = [by_addr[v.address] for v in vset.validators]
+        bid = make_block_id()
+        commit = make_commit(bid, 7, 0, vset, privs_sorted)
+        verify_commit(CHAIN_ID, vset, bid, 7, commit)  # must not raise
+
+    def test_mixed_commit_bad_sig_still_fails(self):
+        privs = [
+            Ed25519PrivKey.from_seed(bytes([i]) * 32) if i % 2 == 0
+            else sr25519.Sr25519PrivKey(bytes([i]) * 32)
+            for i in range(6)
+        ]
+        vset = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+        by_addr = {p.pub_key().address(): p for p in privs}
+        privs_sorted = [by_addr[v.address] for v in vset.validators]
+        bid = make_block_id()
+        commit = make_commit(bid, 7, 0, vset, privs_sorted)
+        commit.signatures[2].signature = bytes(64)
+        with pytest.raises(Exception):
+            verify_commit(CHAIN_ID, vset, bid, 7, commit)
