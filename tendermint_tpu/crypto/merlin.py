@@ -14,8 +14,6 @@ CPU; only the curve math batches onto the device (SURVEY §7 "Hard parts").
 
 from __future__ import annotations
 
-from typing import List
-
 # --- Keccak-f[1600] permutation -------------------------------------------
 
 _ROUND_CONSTANTS = (

@@ -64,6 +64,10 @@ class NodeConfig:
     # tm-db backend selection (config/db.go:29): "memdb" or "filedb".
     # filedb requires `home` (data lands in <home>/data/*.fdb).
     db_backend: str = "memdb"
+    # Remote signer (config PrivValidator.ListenAddr, node/node.go:186):
+    # when set (tcp://... or unix://...), the node listens here for an
+    # out-of-process signer and uses it instead of a local FilePV.
+    priv_validator_laddr: str = ""
     # State sync (config/config.go StateSyncConfig): None disables.
     statesync: Optional["StateSyncConfig"] = None
 
@@ -93,6 +97,28 @@ class Node:
             else:
                 node_key = NodeKey.generate()
         self.node_key = node_key
+        self._signer_endpoint = None
+        if priv_validator is None and config.priv_validator_laddr:
+            # Remote signer (node/node.go:186 createPrivval → signer
+            # listener): listen here, wait for the signer to dial in.
+            from tendermint_tpu.privval.remote import (
+                SignerClient,
+                SignerListenerEndpoint,
+            )
+
+            self._signer_endpoint = SignerListenerEndpoint(
+                config.priv_validator_laddr, node_priv=None
+            )
+            self._signer_endpoint.start()
+            # If construction fails past this point the exception frees the
+            # half-built node; release the bound listener with it rather
+            # than waiting for the socket's own GC close.
+            import weakref
+
+            weakref.finalize(self, self._signer_endpoint.close)
+            priv_validator = SignerClient(
+                self._signer_endpoint, genesis.chain_id
+            )
         if priv_validator is None and config.home:
             priv_validator = FilePV.load_or_generate(
                 os.path.join(config.home, "priv_validator_key.json"),
@@ -414,6 +440,11 @@ class Node:
             except Exception:
                 pass
         self.router.stop()
+        if self._signer_endpoint is not None:
+            try:
+                self._signer_endpoint.close()
+            except Exception:
+                pass
         for db in getattr(self, "_dbs", []):
             try:
                 db.close()

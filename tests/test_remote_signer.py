@@ -1,0 +1,288 @@
+"""Remote signer (socket privval) tests.
+
+Covers: in-process client/server exchange over tcp (SecretConnection) and
+unix sockets, double-sign refusal crossing the wire as an error, the
+signer running as a separate OS process, and a validator node committing
+blocks while signing through the out-of-process signer
+(privval/signer_client.go, signer_server.go,
+signer_listener_endpoint_test.go).
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.node.node import Node, NodeConfig
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.privval.remote import (
+    RemoteSignerError,
+    SignerClient,
+    SignerListenerEndpoint,
+    SignerServer,
+    parse_addr,
+)
+from tendermint_tpu.types.block import BlockID, PartSetHeader, Proposal, Vote
+from tendermint_tpu.encoding.canonical import Timestamp
+
+from tests.test_node import CHAIN, fast_genesis, wait_for
+
+BASE_TS = Timestamp.from_unix_ns(1_700_000_000_000_000_000)
+
+
+def _make_vote(height=1, round_=0, type_=1):
+    return Vote(
+        type=type_,
+        height=height,
+        round=round_,
+        block_id=BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32)),
+        timestamp=BASE_TS,
+        validator_address=b"\x03" * 20,
+        validator_index=0,
+    )
+
+
+@pytest.fixture()
+def file_pv(tmp_path):
+    return FilePV.generate(
+        str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    )
+
+
+def _pair(addr, file_pv):
+    """Start a listener endpoint + an in-process signer dialing it."""
+    ep = SignerListenerEndpoint(addr)
+    ep.start()
+    server = SignerServer(ep.listen_addr, CHAIN, file_pv)
+    server.start()
+    ep.wait_for_connection(10)
+    client = SignerClient(ep, CHAIN)
+    return ep, server, client
+
+
+class TestAddrParse:
+    def test_tcp(self):
+        assert parse_addr("tcp://1.2.3.4:567") == ("tcp", ("1.2.3.4", 567))
+
+    def test_unix(self):
+        assert parse_addr("unix:///tmp/x.sock") == ("unix", "/tmp/x.sock")
+
+    def test_bad_scheme(self):
+        with pytest.raises(ValueError):
+            parse_addr("http://x")
+
+
+class TestInProcess:
+    def test_pubkey_and_vote_over_tcp(self, file_pv):
+        ep, server, client = _pair("tcp://127.0.0.1:0", file_pv)
+        try:
+            assert client.get_pub_key().bytes() == file_pv.get_pub_key().bytes()
+            client.ping()
+            vote = _make_vote()
+            client.sign_vote(CHAIN, vote)
+            assert vote.signature
+            assert file_pv.get_pub_key().verify_signature(
+                vote.sign_bytes(CHAIN), vote.signature
+            )
+        finally:
+            server.stop()
+            ep.close()
+
+    def test_proposal_over_unix(self, file_pv, tmp_path):
+        ep, server, client = _pair(
+            f"unix://{tmp_path}/signer.sock", file_pv
+        )
+        try:
+            prop = Proposal(
+                height=3,
+                round=0,
+                pol_round=-1,
+                block_id=BlockID(b"\x05" * 32, PartSetHeader(1, b"\x06" * 32)),
+                timestamp=BASE_TS,
+            )
+            client.sign_proposal(CHAIN, prop)
+            assert prop.signature
+            assert file_pv.get_pub_key().verify_signature(
+                prop.sign_bytes(CHAIN), prop.signature
+            )
+        finally:
+            server.stop()
+            ep.close()
+
+    def test_double_sign_refused_over_wire(self, file_pv):
+        ep, server, client = _pair("tcp://127.0.0.1:0", file_pv)
+        try:
+            v1 = _make_vote(height=5)
+            client.sign_vote(CHAIN, v1)
+            # conflicting block at same HRS: the signer's last-sign-state
+            # must refuse, and the refusal crosses the wire as an error
+            v2 = _make_vote(height=5)
+            v2.block_id = BlockID(b"\x09" * 32, PartSetHeader(1, b"\x0a" * 32))
+            with pytest.raises(RemoteSignerError, match="double sign"):
+                client.sign_vote(CHAIN, v2)
+            # regression to a lower height is also refused
+            v0 = _make_vote(height=4)
+            with pytest.raises(RemoteSignerError):
+                client.sign_vote(CHAIN, v0)
+        finally:
+            server.stop()
+            ep.close()
+
+    def test_unauthorized_signer_rejected(self, file_pv):
+        from tendermint_tpu.crypto.keys import Ed25519PrivKey
+
+        allowed_identity = Ed25519PrivKey.generate()
+        ep = SignerListenerEndpoint(
+            "tcp://127.0.0.1:0",
+            authorized_keys=[allowed_identity.pub_key().bytes()],
+        )
+        ep.start()
+        # signer dials with a DIFFERENT identity -> endpoint must refuse
+        stranger = SignerServer(
+            ep.listen_addr, CHAIN, file_pv,
+            signer_identity=Ed25519PrivKey.generate(),
+            max_dial_retries=5,
+        )
+        stranger.start()
+        try:
+            # the stranger's dials are each rejected; the wait never
+            # yields a connection and reports the rejections on timeout
+            with pytest.raises(RemoteSignerError, match="timed out"):
+                ep.wait_for_connection(2)
+        finally:
+            stranger.stop()
+        # the authorized identity connects fine
+        legit = SignerServer(
+            ep.listen_addr, CHAIN, file_pv,
+            signer_identity=allowed_identity, max_dial_retries=20,
+        )
+        legit.start()
+        try:
+            ep.wait_for_connection(10)
+            SignerClient(ep, CHAIN).ping()
+        finally:
+            legit.stop()
+            ep.close()
+
+    def test_signer_reconnects_after_drop(self, file_pv):
+        ep, server, client = _pair("tcp://127.0.0.1:0", file_pv)
+        try:
+            client.ping()
+            # sever the current connection from the node side; the signer's
+            # dial loop must re-establish it
+            with ep._lock:
+                ep._drop_conn_locked()
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    ep.wait_for_connection(2)
+                    client.ping()
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise
+        finally:
+            server.stop()
+            ep.close()
+
+
+class TestOutOfProcess:
+    def test_subprocess_signer_signs(self, tmp_path):
+        key_file = str(tmp_path / "k.json")
+        state_file = str(tmp_path / "s.json")
+        # pre-generate so the parent knows the expected pubkey
+        pv = FilePV.generate(key_file, state_file)
+        expected_pub = pv.get_pub_key().bytes()
+
+        ep = SignerListenerEndpoint("tcp://127.0.0.1:0")
+        ep.start()
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "tendermint_tpu.privval.remote",
+                "--addr",
+                ep.listen_addr,
+                "--chain-id",
+                CHAIN,
+                "--key-file",
+                key_file,
+                "--state-file",
+                state_file,
+            ],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        try:
+            ep.wait_for_connection(15)
+            client = SignerClient(ep, CHAIN)
+            assert client.get_pub_key().bytes() == expected_pub
+            vote = _make_vote(height=2)
+            client.sign_vote(CHAIN, vote)
+            assert client.get_pub_key().verify_signature(
+                vote.sign_bytes(CHAIN), vote.signature
+            )
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+            ep.close()
+
+    def test_node_commits_via_remote_signer(self, tmp_path):
+        """A single-validator node with no local key signs every proposal
+        and vote through the out-of-process signer and still commits."""
+        import socket as socketlib
+
+        key_file = str(tmp_path / "k.json")
+        state_file = str(tmp_path / "s.json")
+        pv = FilePV.generate(key_file, state_file)
+        genesis = fast_genesis([pv])
+
+        # Reserve a port for the privval listener: the node binds it during
+        # construction, but construction itself asks the signer for the
+        # pubkey, so the signer process must already be dialing by then.
+        # SO_REUSEADDR on the listener covers the close->rebind window.
+        probe = socketlib.socket()
+        probe.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        laddr = f"tcp://127.0.0.1:{port}"
+
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "tendermint_tpu.privval.remote",
+                "--addr",
+                laddr,
+                "--chain-id",
+                CHAIN,
+                "--key-file",
+                key_file,
+                "--state-file",
+                state_file,
+            ],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        cfg = NodeConfig(
+            chain_id=CHAIN,
+            listen_addr="127.0.0.1:0",
+            wal_enabled=False,
+            priv_validator_laddr=laddr,
+            moniker="remote-signed",
+        )
+        node = Node(cfg, genesis, LocalClient(KVStoreApplication()))
+        try:
+            node._signer_endpoint.wait_for_connection(15)
+            node.start()
+            assert wait_for(lambda: node.height >= 2, timeout=60), (
+                f"height: {node.height}"
+            )
+        finally:
+            node.stop()
+            proc.terminate()
+            proc.wait(timeout=10)
