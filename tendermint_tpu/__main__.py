@@ -1,0 +1,5 @@
+"""``python -m tendermint_tpu`` → operator CLI (cmd/tendermint/main.go)."""
+
+from tendermint_tpu.cli import main
+
+raise SystemExit(main())

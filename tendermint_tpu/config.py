@@ -1,0 +1,197 @@
+"""Typed node configuration with TOML persistence.
+
+The operator-facing analog of the reference's single Config struct tree
+(config/config.go:62-1182) and its TOML template (config/toml.go):
+``Config.load``/``save`` round-trip ``<home>/config/config.toml``, and
+``to_node_config()`` produces the runtime NodeConfig the node assembly
+consumes. Reading uses the stdlib ``tomllib``; writing uses a small
+emitter covering the value types the config needs (str/bool/int/float/
+str-list).
+
+Sections mirror the reference file: [base] (top-level keys), [p2p],
+[rpc], [mempool], [statesync], [privval]. Consensus timeouts are NOT
+here — they live on-chain in ConsensusParams (types/params.go:91), which
+genesis carries.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field as dc_field, fields
+from typing import List, Optional
+
+from tendermint_tpu.mempool.mempool import MempoolConfig
+from tendermint_tpu.node.node import NodeConfig
+from tendermint_tpu.statesync.syncer import StateSyncConfig
+
+DEFAULT_CONFIG_DIR = "config"
+DEFAULT_DATA_DIR = "data"
+DEFAULT_CONFIG_FILE = "config.toml"
+DEFAULT_GENESIS_FILE = "genesis.json"
+DEFAULT_NODE_KEY_FILE = "node_key.json"
+DEFAULT_PRIVVAL_KEY_FILE = "priv_validator_key.json"
+DEFAULT_PRIVVAL_STATE_FILE = "priv_validator_state.json"
+
+
+@dataclass
+class BaseConfig:
+    """config/config.go BaseConfig (condensed)."""
+
+    moniker: str = "tpu-node"
+    # ABCI application: "kvstore" (in-process), "persistent_kvstore"
+    # (filedb-backed, in-process), or "tcp://host:port" for an
+    # out-of-process socket app (config.go ProxyApp).
+    proxy_app: str = "kvstore"
+    db_backend: str = "filedb"
+    blocksync: bool = True
+    wal_enabled: bool = True
+
+
+@dataclass
+class P2PConfig:
+    """config/config.go P2PConfig (condensed)."""
+
+    laddr: str = "127.0.0.1:26656"
+    persistent_peers: List[str] = dc_field(default_factory=list)
+    max_connections: int = 16
+
+
+@dataclass
+class RPCConfig:
+    """config/config.go RPCConfig (condensed)."""
+
+    laddr: str = "127.0.0.1:26657"
+
+
+@dataclass
+class PrivValidatorConfig:
+    """config/config.go PrivValidatorConfig: empty laddr = local FilePV."""
+
+    laddr: str = ""
+
+
+@dataclass
+class IndexerConfig:
+    enabled: bool = True
+
+
+@dataclass
+class Config:
+    home: str = ""
+    base: BaseConfig = dc_field(default_factory=BaseConfig)
+    p2p: P2PConfig = dc_field(default_factory=P2PConfig)
+    rpc: RPCConfig = dc_field(default_factory=RPCConfig)
+    mempool: MempoolConfig = dc_field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = dc_field(default_factory=StateSyncConfig)
+    privval: PrivValidatorConfig = dc_field(
+        default_factory=PrivValidatorConfig
+    )
+    indexer: IndexerConfig = dc_field(default_factory=IndexerConfig)
+
+    # --- derived paths ------------------------------------------------------
+
+    def config_dir(self) -> str:
+        return os.path.join(self.home, DEFAULT_CONFIG_DIR)
+
+    def data_dir(self) -> str:
+        return os.path.join(self.home, DEFAULT_DATA_DIR)
+
+    def config_file(self) -> str:
+        return os.path.join(self.config_dir(), DEFAULT_CONFIG_FILE)
+
+    def genesis_file(self) -> str:
+        return os.path.join(self.config_dir(), DEFAULT_GENESIS_FILE)
+
+    def node_key_file(self) -> str:
+        return os.path.join(self.config_dir(), DEFAULT_NODE_KEY_FILE)
+
+    def privval_key_file(self) -> str:
+        return os.path.join(self.config_dir(), DEFAULT_PRIVVAL_KEY_FILE)
+
+    def privval_state_file(self) -> str:
+        return os.path.join(self.data_dir(), DEFAULT_PRIVVAL_STATE_FILE)
+
+    # --- conversion ---------------------------------------------------------
+
+    def to_node_config(self, chain_id: str = "") -> NodeConfig:
+        return NodeConfig(
+            home=self.home,
+            chain_id=chain_id,
+            listen_addr=self.p2p.laddr,
+            persistent_peers=list(self.p2p.persistent_peers),
+            mempool=self.mempool,
+            blocksync=self.base.blocksync,
+            wal_enabled=self.base.wal_enabled,
+            max_connections=self.p2p.max_connections,
+            moniker=self.base.moniker,
+            rpc_laddr=self.rpc.laddr,
+            tx_index=self.indexer.enabled,
+            db_backend=self.base.db_backend,
+            statesync=self.statesync if self.statesync.enabled else None,
+            priv_validator_laddr=self.privval.laddr,
+        )
+
+    # --- TOML ---------------------------------------------------------------
+
+    _SECTIONS = ("base", "p2p", "rpc", "mempool", "statesync", "privval", "indexer")
+
+    def to_toml(self) -> str:
+        out = [
+            "# tendermint_tpu node configuration",
+            "# (config/toml.go analog; consensus timeouts live in genesis"
+            " consensus_params)",
+            "",
+        ]
+        for section in self._SECTIONS:
+            obj = getattr(self, section)
+            out.append(f"[{section}]")
+            for f in fields(obj):
+                out.append(f"{f.name} = {_emit(getattr(obj, f.name))}")
+            out.append("")
+        return "\n".join(out)
+
+    @classmethod
+    def from_toml(cls, text: str, home: str = "") -> "Config":
+        doc = tomllib.loads(text)
+        cfg = cls(home=home)
+        for section in cls._SECTIONS:
+            data = doc.get(section)
+            if not isinstance(data, dict):
+                continue
+            obj = getattr(cfg, section)
+            for f in fields(obj):
+                if f.name in data:
+                    value = data[f.name]
+                    if f.name == "trust_hash" and isinstance(value, str):
+                        value = bytes.fromhex(value)
+                    setattr(obj, f.name, value)
+        return cfg
+
+    def save(self) -> None:
+        os.makedirs(self.config_dir(), exist_ok=True)
+        with open(self.config_file(), "w") as fh:
+            fh.write(self.to_toml())
+
+    @classmethod
+    def load(cls, home: str) -> "Config":
+        path = os.path.join(home, DEFAULT_CONFIG_DIR, DEFAULT_CONFIG_FILE)
+        with open(path, "rb") as fh:
+            text = fh.read().decode()
+        return cls.from_toml(text, home=home)
+
+
+def _emit(value) -> str:
+    """Emit one TOML value (the subset our config uses)."""
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, (int, float)):
+        return repr(value)
+    if isinstance(value, bytes):
+        return f'"{value.hex()}"'
+    if isinstance(value, str):
+        escaped = value.replace("\\", "\\\\").replace('"', '\\"')
+        return f'"{escaped}"'
+    if isinstance(value, (list, tuple)):
+        return "[" + ", ".join(_emit(v) for v in value) + "]"
+    raise TypeError(f"cannot emit TOML for {type(value).__name__}")

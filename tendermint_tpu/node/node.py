@@ -68,6 +68,8 @@ class NodeConfig:
     # when set (tcp://... or unix://...), the node listens here for an
     # out-of-process signer and uses it instead of a local FilePV.
     priv_validator_laddr: str = ""
+    # How long node construction waits for the signer to dial in.
+    signer_connect_timeout: float = 60.0
     # State sync (config/config.go StateSyncConfig): None disables.
     statesync: Optional["StateSyncConfig"] = None
 
@@ -116,6 +118,13 @@ class Node:
             import weakref
 
             weakref.finalize(self, self._signer_endpoint.close)
+            # Construction below asks the signer for its pubkey; wait here
+            # with retry-on-garbage-dials so an absent signer surfaces as
+            # a clear error instead of a raw accept() timeout deep inside
+            # consensus setup (SignerClient.WaitForConnection analog).
+            self._signer_endpoint.wait_for_connection(
+                config.signer_connect_timeout
+            )
             priv_validator = SignerClient(
                 self._signer_endpoint, genesis.chain_id
             )

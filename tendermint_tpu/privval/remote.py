@@ -39,6 +39,7 @@ from tendermint_tpu.crypto.keys import (
     PubKey,
     pubkey_from_type_and_bytes,
 )
+from tendermint_tpu.p2p.secret_connection import SecretConnectionError
 from tendermint_tpu.privval.base import PrivValidator
 from tendermint_tpu.privval.file_pv import DoubleSignError
 from tendermint_tpu.types.block import Proposal, Vote
@@ -53,6 +54,10 @@ DEFAULT_DIAL_RETRY_INTERVAL = 0.1
 
 class RemoteSignerError(Exception):
     """An error string returned by the remote signer (privval/errors.go)."""
+
+
+class UnauthorizedSignerError(RemoteSignerError):
+    """A dialer whose handshake identity is not in the allowlist."""
 
 
 def parse_addr(addr: str) -> Tuple[str, object]:
@@ -165,6 +170,12 @@ class SignerListenerEndpoint:
         self._authorized = (
             {bytes(k) for k in authorized_keys} if authorized_keys else None
         )
+        if self._authorized is not None and self._scheme == "unix":
+            # no SecretConnection on unix sockets -> no handshake identity
+            # to check against; filesystem permissions are the boundary
+            raise ValueError(
+                "authorized_keys requires a tcp:// privval address"
+            )
         self._lock = threading.Lock()
         self._conn: Optional[_Conn] = None
         self._listener: Optional[socket.socket] = None
@@ -184,7 +195,9 @@ class SignerListenerEndpoint:
                 pass
             ls = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             ls.bind(self._target)
-        ls.listen(1)
+        # backlog > 1: a dead dial sitting in the queue must not make the
+        # real signer's connection attempt bounce off a full backlog
+        ls.listen(8)
         ls.settimeout(self._accept_timeout)
         self._listener = ls
 
@@ -196,65 +209,97 @@ class SignerListenerEndpoint:
             return f"tcp://{host}:{port}"
         return f"unix://{self._target}"
 
-    def _ensure_conn(self) -> _Conn:
+    def _ensure_conn(self, accept_timeout: Optional[float] = None) -> _Conn:
         if self._conn is not None:
             return self._conn
+        if self._closed:
+            raise RemoteSignerError("signer endpoint closed")
         if self._listener is None:
             raise RemoteSignerError("listener not started")
+        if accept_timeout is not None:
+            self._listener.settimeout(accept_timeout)
         sock, _ = self._listener.accept()
         sock.settimeout(self._io_timeout)
-        conn = _Conn(sock, self._priv)
+        try:
+            conn = _Conn(sock, self._priv)
+        except Exception:
+            # handshake failure (port scanner, dropped dial, garbage):
+            # release the accepted socket before surfacing
+            sock.close()
+            raise
         if self._authorized is not None:
             remote = conn.remote_pubkey
             if remote is None or remote.bytes() not in self._authorized:
                 conn.close()
-                raise RemoteSignerError(
+                raise UnauthorizedSignerError(
                     "signer connection rejected: unauthorized identity"
                 )
         self._conn = conn
         return self._conn
 
     def wait_for_connection(self, max_wait: float) -> None:
-        """Block until a signer has dialed in (SignerClient.WaitForConnection)."""
+        """Block until a signer has dialed in (SignerClient.WaitForConnection).
+
+        Rejected or failed dial attempts — unauthorized identities, port
+        scanners dropping mid-handshake — do not end the wait; only the
+        deadline does.
+        """
         deadline = time.monotonic() + max_wait
+        rejected = 0
         with self._lock:
             old = self._listener.gettimeout() if self._listener else None
-            while True:
-                try:
-                    if self._listener is not None:
-                        self._listener.settimeout(
-                            max(0.05, deadline - time.monotonic())
+            try:
+                while True:
+                    try:
+                        self._ensure_conn(
+                            accept_timeout=max(
+                                0.05, deadline - time.monotonic()
+                            )
                         )
-                    self._ensure_conn()
-                    return
-                except socket.timeout:
+                        return
+                    except socket.timeout:
+                        pass
+                    except (
+                        UnauthorizedSignerError,
+                        ConnectionError,
+                        SecretConnectionError,
+                        OSError,
+                    ):
+                        rejected += 1
                     if time.monotonic() >= deadline:
+                        suffix = (
+                            f" ({rejected} dial attempts rejected)"
+                            if rejected
+                            else ""
+                        )
                         raise RemoteSignerError(
                             "timed out waiting for signer to connect"
+                            + suffix
                         ) from None
-                except RemoteSignerError as e:
-                    # an unauthorized dialer must not end the wait for the
-                    # real signer; keep accepting until the deadline
-                    if "unauthorized" not in str(e):
-                        raise
-                    if time.monotonic() >= deadline:
-                        raise RemoteSignerError(
-                            "timed out waiting for signer to connect "
-                            "(unauthorized dial attempts rejected)"
-                        ) from None
-                finally:
-                    if self._listener is not None and old is not None:
-                        self._listener.settimeout(old)
+            finally:
+                if self._listener is not None and old is not None:
+                    self._listener.settimeout(old)
 
     def send_request(self, msg: dict) -> dict:
         """One request/response exchange; drops the connection on IO error
-        so the signer's redial can re-establish it."""
+        so the signer's redial can re-establish it.
+
+        When no signer is connected, waits at most ``io_timeout`` for one
+        to dial in — the caller is usually the consensus thread, which
+        must fail fast and skip its vote rather than stall a round
+        (accept_timeout is only for explicit wait_for_connection calls).
+        """
         with self._lock:
-            conn = self._ensure_conn()
+            conn = self._ensure_conn(accept_timeout=self._io_timeout)
             try:
                 conn.send_msg(msg)
                 return conn.recv_msg()
-            except (OSError, ConnectionError, json.JSONDecodeError):
+            except (
+                OSError,
+                ConnectionError,
+                SecretConnectionError,
+                json.JSONDecodeError,
+            ):
                 self._drop_conn_locked()
                 raise
 
@@ -406,7 +451,7 @@ class SignerServer:
                     sock.connect(self._target)
                 sock.settimeout(DEFAULT_TIMEOUT_READ_WRITE)
                 return _Conn(sock, self._identity)
-            except OSError as e:
+            except (OSError, SecretConnectionError, ConnectionError) as e:
                 last_err = e
                 time.sleep(self._dial_retry_interval)
         raise ConnectionError(f"signer could not dial node: {last_err}")
@@ -422,9 +467,16 @@ class SignerServer:
                     try:
                         req = conn.recv_msg()
                     except socket.timeout:
+                        # safe: _SocketStream buffers partial reads, so a
+                        # mid-frame timeout resumes without desync
                         continue
                     conn.send_msg(self._handle(req))
-            except (OSError, ConnectionError, json.JSONDecodeError):
+            except (
+                OSError,
+                ConnectionError,
+                SecretConnectionError,
+                json.JSONDecodeError,
+            ):
                 conn.close()
                 continue
 

@@ -1,0 +1,268 @@
+"""CLI + TOML config tests (cmd/tendermint + config/toml.go analogs).
+
+The flagship case mirrors the reference testnet flow: generate 4 home
+dirs with `testnet`, start 4 separate OS processes with `start`, and
+watch every node commit blocks over real TCP with filedb persistence.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.config import Config
+from tendermint_tpu.cli import main as cli_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port_block(n: int) -> int:
+    """Find a base port with n*2 consecutive free ports (best effort)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    base = s.getsockname()[1]
+    s.close()
+    # steer clear of the ephemeral range edge
+    return base if base + 2 * n < 65000 else base - 4 * n
+
+
+def _rpc_height(port: int) -> int:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/status", timeout=2
+    ) as resp:
+        doc = json.load(resp)
+    return int(doc["result"]["sync_info"]["latest_block_height"])
+
+
+def _run(args) -> int:
+    return cli_main(args)
+
+
+class TestConfigToml:
+    def test_roundtrip(self, tmp_path):
+        cfg = Config(home=str(tmp_path))
+        cfg.base.moniker = "alpha"
+        cfg.base.proxy_app = "persistent_kvstore"
+        cfg.p2p.laddr = "127.0.0.1:11111"
+        cfg.p2p.persistent_peers = ["aa@1.2.3.4:5", "bb@6.7.8.9:10"]
+        cfg.rpc.laddr = "127.0.0.1:22222"
+        cfg.mempool.size = 77
+        cfg.statesync.enabled = True
+        cfg.statesync.trust_height = 42
+        cfg.statesync.trust_hash = b"\xab\xcd"
+        cfg.privval.laddr = "tcp://127.0.0.1:33333"
+        cfg.save()
+
+        loaded = Config.load(str(tmp_path))
+        assert loaded.base.moniker == "alpha"
+        assert loaded.base.proxy_app == "persistent_kvstore"
+        assert loaded.p2p.persistent_peers == cfg.p2p.persistent_peers
+        assert loaded.mempool.size == 77
+        assert loaded.statesync.enabled is True
+        assert loaded.statesync.trust_height == 42
+        assert loaded.statesync.trust_hash == b"\xab\xcd"
+        assert loaded.privval.laddr == "tcp://127.0.0.1:33333"
+
+    def test_to_node_config(self, tmp_path):
+        cfg = Config(home=str(tmp_path))
+        cfg.statesync.enabled = False
+        nc = cfg.to_node_config(chain_id="x")
+        assert nc.chain_id == "x"
+        assert nc.statesync is None  # disabled -> not wired
+        cfg.statesync.enabled = True
+        assert cfg.to_node_config().statesync is cfg.statesync
+
+    def test_unknown_keys_ignored(self, tmp_path):
+        text = '[base]\nmoniker = "m"\nfuture_knob = 3\n[bogus]\nx = 1\n'
+        cfg = Config.from_toml(text)
+        assert cfg.base.moniker == "m"
+
+
+class TestInitAndKeys:
+    def test_init_creates_layout(self, tmp_path):
+        home = str(tmp_path / "h")
+        assert _run(["--home", home, "init", "--chain-id", "c1"]) == 0
+        cfg = Config(home=home)
+        for path in (
+            cfg.config_file(),
+            cfg.genesis_file(),
+            cfg.node_key_file(),
+            cfg.privval_key_file(),
+        ):
+            assert os.path.exists(path), path
+        # refuses to clobber without --force
+        assert _run(["--home", home, "init"]) == 1
+        assert _run(["--home", home, "init", "--force"]) == 0
+
+    def test_show_commands(self, tmp_path, capsys):
+        home = str(tmp_path / "h")
+        _run(["--home", home, "init"])
+        capsys.readouterr()  # drain init output
+        assert _run(["--home", home, "show-node-id"]) == 0
+        node_id = capsys.readouterr().out.strip()
+        assert len(node_id) == 40
+        assert _run(["--home", home, "show-validator"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["type"] == "ed25519" and doc["value"]
+
+    def test_unsafe_reset_keeps_keys(self, tmp_path):
+        home = str(tmp_path / "h")
+        _run(["--home", home, "init"])
+        cfg = Config(home=home)
+        key_before = open(cfg.privval_key_file()).read()
+        marker = os.path.join(cfg.data_dir(), "junk.db")
+        open(marker, "w").write("x")
+        assert _run(["--home", home, "unsafe-reset-all"]) == 0
+        assert not os.path.exists(marker)
+        assert open(cfg.privval_key_file()).read() == key_before
+
+    def test_start_without_init_errors(self, tmp_path):
+        assert _run(["--home", str(tmp_path / "nope"), "start"]) == 1
+
+
+def _fast_genesis_overwrite(home: str) -> None:
+    """Shrink consensus timeouts for test speed (operators tune these via
+    genesis consensus_params; tests are just an aggressive operator)."""
+    from tendermint_tpu.types.genesis import GenesisDoc
+    from tendermint_tpu.types.params import TimeoutParams
+
+    cfg = Config(home=home)
+    doc = GenesisDoc.from_file(cfg.genesis_file())
+    doc.consensus_params.timeout = TimeoutParams(
+        propose=0.6, propose_delta=0.2, vote=0.3, vote_delta=0.1, commit=0.1
+    )
+    doc.save_as(cfg.genesis_file())
+
+
+class TestNodeLifecycle:
+    def _spawn(self, home: str):
+        return subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu", "--home", home, "start"],
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+
+    def _wait_height(self, port: int, target: int, timeout: float) -> int:
+        deadline = time.monotonic() + timeout
+        height = -1
+        while time.monotonic() < deadline:
+            try:
+                height = _rpc_height(port)
+                if height >= target:
+                    return height
+            except Exception:
+                pass
+            time.sleep(0.5)
+        return height
+
+    def test_single_node_commits_and_persists(self, tmp_path):
+        home = str(tmp_path / "n0")
+        _run(["--home", home, "init", "--chain-id", "cli-one"])
+        _fast_genesis_overwrite(home)
+        port = _free_port_block(1)
+        cfg = Config.load(home)
+        cfg.p2p.laddr = f"127.0.0.1:{port}"
+        cfg.rpc.laddr = f"127.0.0.1:{port + 1}"
+        cfg.save()
+
+        proc = self._spawn(home)
+        try:
+            height = self._wait_height(port + 1, 3, timeout=60)
+            assert height >= 3, f"node never reached height 3 (got {height})"
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=15)
+
+        # stores survived shutdown: inspect sees the committed chain
+        out = subprocess.run(
+            [sys.executable, "-m", "tendermint_tpu", "--home", home, "inspect"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+        doc = json.loads(out.stdout)
+        assert doc["latest_block_height"] >= 3
+        assert doc["chain_id"] == "cli-one"
+
+    def test_four_process_testnet_commits(self, tmp_path):
+        """VERDICT round-2 item 10 'Done =': a 4-process localhost testnet
+        starts from generated configs and commits blocks."""
+        out_dir = str(tmp_path / "tn")
+        base = _free_port_block(4)
+        assert (
+            _run(
+                [
+                    "testnet",
+                    "-v",
+                    "4",
+                    "-o",
+                    out_dir,
+                    "--chain-id",
+                    "cli-tn",
+                    "--starting-port",
+                    str(base),
+                ]
+            )
+            == 0
+        )
+        homes = [os.path.join(out_dir, f"node{i}") for i in range(4)]
+        for home in homes:
+            _fast_genesis_overwrite(home)
+        procs = [self._spawn(h) for h in homes]
+        try:
+            heights = [
+                self._wait_height(base + 2 * i + 1, 2, timeout=90)
+                for i in range(4)
+            ]
+            assert all(h >= 2 for h in heights), f"heights: {heights}"
+        finally:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+class TestRollback:
+    def test_rollback_then_restart(self, tmp_path):
+        home = str(tmp_path / "n0")
+        _run(["--home", home, "init", "--chain-id", "rb"])
+        _fast_genesis_overwrite(home)
+        port = _free_port_block(1)
+        cfg = Config.load(home)
+        cfg.p2p.laddr = f"127.0.0.1:{port}"
+        cfg.rpc.laddr = f"127.0.0.1:{port + 1}"
+        cfg.save()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu", "--home", home, "start"],
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        try:
+            deadline = time.monotonic() + 60
+            height = -1
+            while time.monotonic() < deadline and height < 3:
+                try:
+                    height = _rpc_height(port + 1)
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            assert height >= 3
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=15)
+
+        assert _run(["--home", home, "rollback"]) == 0
+        # replay pushes the stored blocks back into a fresh app
+        assert _run(["--home", home, "replay"]) == 0
