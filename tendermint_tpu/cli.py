@@ -129,31 +129,33 @@ def _build_node(cfg: Config):
 def cmd_start(args) -> int:
     """commands/run_node.go: assemble and run until SIGINT/SIGTERM."""
     cfg = _load_cfg(args)
-    stopping = []
 
     def _stop(_sig, _frm):
-        stopping.append(True)
+        # raising interrupts even blocking calls (accept() in the signer
+        # wait, handshake replay) instead of waiting for them to finish
+        raise KeyboardInterrupt
 
-    # register before the (possibly slow: handshake replay, filedb open)
-    # node build so an early SIGTERM still exits through node cleanup
     signal.signal(signal.SIGINT, _stop)
     signal.signal(signal.SIGTERM, _stop)
-    node = _build_node(cfg)
-    if stopping:
-        return 0
-    node.start()
-    print(
-        f"node {node.node_key.node_id} started "
-        f"(p2p {cfg.p2p.laddr}, rpc {cfg.rpc.laddr})",
-        flush=True,
-    )
-    last_height = -1
     try:
-        while not stopping:
+        node = _build_node(cfg)
+    except KeyboardInterrupt:
+        return 0
+    try:
+        node.start()
+        print(
+            f"node {node.node_key.node_id} started "
+            f"(p2p {cfg.p2p.laddr}, rpc {cfg.rpc.laddr})",
+            flush=True,
+        )
+        last_height = -1
+        while True:
             time.sleep(0.2)
             if node.height != last_height:
                 last_height = node.height
                 print(f"height={last_height}", flush=True)
+    except KeyboardInterrupt:
+        pass
     finally:
         node.stop()
     return 0
@@ -403,6 +405,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (ValueError, OSError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    except Exception as e:
+        # operator-facing failures from deeper layers (e.g. the remote
+        # signer never dialing in) should read as errors, not tracebacks
+        from tendermint_tpu.privval.remote import RemoteSignerError
+
+        if isinstance(e, RemoteSignerError):
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        raise
 
 
 if __name__ == "__main__":

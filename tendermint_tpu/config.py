@@ -69,6 +69,7 @@ class PrivValidatorConfig:
     """config/config.go PrivValidatorConfig: empty laddr = local FilePV."""
 
     laddr: str = ""
+    connect_timeout: float = 60.0  # wait for the signer to dial in
 
 
 @dataclass
@@ -130,6 +131,7 @@ class Config:
             db_backend=self.base.db_backend,
             statesync=self.statesync if self.statesync.enabled else None,
             priv_validator_laddr=self.privval.laddr,
+            signer_connect_timeout=self.privval.connect_timeout,
         )
 
     # --- TOML ---------------------------------------------------------------
@@ -163,7 +165,12 @@ class Config:
             for f in fields(obj):
                 if f.name in data:
                     value = data[f.name]
-                    if f.name == "trust_hash" and isinstance(value, str):
+                    # bytes fields are emitted as hex (see _emit); key the
+                    # reverse conversion on the field's current type, not
+                    # its name, so every bytes field round-trips
+                    if isinstance(getattr(obj, f.name), bytes) and isinstance(
+                        value, str
+                    ):
                         value = bytes.fromhex(value)
                     setattr(obj, f.name, value)
         return cfg
