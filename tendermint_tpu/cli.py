@@ -137,11 +137,9 @@ def cmd_start(args) -> int:
 
     signal.signal(signal.SIGINT, _stop)
     signal.signal(signal.SIGTERM, _stop)
+    node = None
     try:
         node = _build_node(cfg)
-    except KeyboardInterrupt:
-        return 0
-    try:
         node.start()
         print(
             f"node {node.node_key.node_id} started "
@@ -157,7 +155,11 @@ def cmd_start(args) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        node.stop()
+        # a second signal must not abort the shutdown mid-way
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        if node is not None:
+            node.stop()
     return 0
 
 

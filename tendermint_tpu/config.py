@@ -39,6 +39,7 @@ class BaseConfig:
     """config/config.go BaseConfig (condensed)."""
 
     moniker: str = "tpu-node"
+    log_level: str = "info"  # debug/info/warn/error/none
     # ABCI application: "kvstore" (in-process), "persistent_kvstore"
     # (filedb-backed, in-process), or "tcp://host:port" for an
     # out-of-process socket app (config.go ProxyApp).
@@ -132,6 +133,7 @@ class Config:
             statesync=self.statesync if self.statesync.enabled else None,
             priv_validator_laddr=self.privval.laddr,
             signer_connect_timeout=self.privval.connect_timeout,
+            log_level=self.base.log_level,
         )
 
     # --- TOML ---------------------------------------------------------------

@@ -85,16 +85,25 @@ class ConsensusState:
         broadcaster: Optional[Broadcaster] = None,
         now: Optional[Callable[[], Timestamp]] = None,
         on_committed: Optional[Callable[[int], None]] = None,
+        metrics=None,
+        logger=None,
     ):
+        from tendermint_tpu.libs.log import NOP_LOGGER
+        from tendermint_tpu.libs.metrics import ConsensusMetrics
+
         self.block_exec = block_exec
         self.block_store = block_store
         self.priv_validator = priv_validator
         self.priv_pub_key = priv_validator.get_pub_key() if priv_validator else None
         self.wal = wal or NilWAL()
+        self._wal_is_real = not isinstance(self.wal, NilWAL)
         self.broadcaster = broadcaster or Broadcaster()
         self.event_bus = None  # set by the node (node.go wires eventbus)
         self._now = now or (lambda: Timestamp.from_unix_ns(_time.time_ns()))
         self.on_committed = on_committed
+        self.metrics = metrics or ConsensusMetrics.nop()
+        self.logger = (logger or NOP_LOGGER).with_fields(module="consensus")
+        self._last_commit_walltime: Optional[float] = None
 
         self.rs = cstypes.RoundState()
         self.state = SMState()  # set by _update_to_state
@@ -178,6 +187,8 @@ class ConsensusState:
                     ti = self.timeout_queue.get_nowait()
                     with self._mtx:
                         self.wal.write(ti)
+                        if self._wal_is_real:
+                            self.metrics.wal_writes.inc()
                         self._handle_timeout(ti)
                     processed = True
             except queue.Empty:
@@ -186,6 +197,8 @@ class ConsensusState:
                 mi = self.internal_queue.get_nowait()
                 with self._mtx:
                     self.wal.write_sync(mi)  # fsync own messages (state.go:964)
+                    if self._wal_is_real:
+                        self.metrics.wal_writes.inc()
                     self._handle_msg(mi)
                 processed = True
             except queue.Empty:
@@ -195,6 +208,8 @@ class ConsensusState:
                     mi = self.peer_queue.get_nowait()
                     with self._mtx:
                         self.wal.write(mi)
+                        if self._wal_is_real:
+                            self.metrics.wal_writes.inc()
                         # Peer input must never kill the loop: malformed
                         # messages are dropped (state.go handleMsg logs
                         # and continues).
@@ -360,6 +375,10 @@ class ConsensusState:
             rs.proposal_block_parts = None
         rs.votes.set_round(round_ + 1)  # track next round for round-skipping
         rs.triggered_timeout_precommit = False
+        self.metrics.height.set(height)
+        self.metrics.rounds.set(round_)
+        self.metrics.validators.set(len(validators.validators))
+        self.logger.debug("entering new round", height=height, round=round_)
         self._publish_event(
             "publish_event_new_round",
             lambda eb: eb.EventDataNewRound(
@@ -708,6 +727,29 @@ class ConsensusState:
             state_copy, BlockID(block.hash(), block_parts.header()), block
         )
         self._update_to_state(state_copy)
+
+        now_wall = _time.monotonic()
+        if self._last_commit_walltime is not None:
+            self.metrics.block_interval_seconds.observe(
+                now_wall - self._last_commit_walltime
+            )
+        self._last_commit_walltime = now_wall
+        self.metrics.num_txs.set(len(block.data.txs))
+        # block_parts carries the serialized block; don't re-encode under
+        # the consensus mutex just to measure the size
+        self.metrics.block_size_bytes.set(block_parts.byte_size)
+        self.metrics.total_txs.inc(len(block.data.txs))
+        n_absent = sum(
+            1 for cs in block.last_commit.signatures if cs.is_absent()
+        ) if block.last_commit else 0
+        self.metrics.missing_validators.set(n_absent)
+        self.logger.info(
+            "committed block",
+            height=height,
+            hash=block.hash(),
+            txs=len(block.data.txs),
+        )
+
         if self.priv_validator is not None:
             self.priv_pub_key = self.priv_validator.get_pub_key()
         if self.on_committed is not None:

@@ -1,0 +1,368 @@
+"""Prometheus-style metrics: registry, instruments, text exposition.
+
+The reference generates per-package metric structs with ``metricsgen``
+(e.g. internal/consensus/metrics.gen.go) and serves a node-level
+registry over HTTP (node/node.go:575-605). Here the instruments are
+hand-rolled — Counter, Gauge, Histogram with label support — gathered
+into the standard text exposition format and served by the RPC server
+at ``GET /metrics``.
+
+Every subsystem struct offers ``nop()`` so library construction without
+a registry measures nothing and costs (almost) nothing — the same role
+as the reference's NopMetrics constructors.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+NAMESPACE = "tendermint"
+
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _fmt(v: float) -> str:
+    if v == int(v):
+        return str(int(v))
+    return repr(v)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(labels.items()))
+
+
+def _label_str(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def collect(self) -> List[str]:  # exposition lines
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple, float] = {}
+
+    def labels(self, **labels: str) -> "_BoundCounter":
+        return _BoundCounter(self, _label_key(labels))
+
+    def inc(self, n: float = 1.0) -> None:
+        self.labels().inc(n)
+
+    def collect(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items:
+            items = [((), 0.0)]
+        return [
+            f"{self.name}{_label_str(k)} {_fmt(v)}" for k, v in items
+        ]
+
+
+class _BoundCounter:
+    __slots__ = ("_m", "_k")
+
+    def __init__(self, metric: Counter, key: Tuple):
+        self._m = metric
+        self._k = key
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._m._lock:
+            self._m._values[self._k] = self._m._values.get(self._k, 0.0) + n
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple, float] = {}
+
+    def labels(self, **labels: str) -> "_BoundGauge":
+        return _BoundGauge(self, _label_key(labels))
+
+    def set(self, v: float) -> None:
+        self.labels().set(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self.labels().inc(n)
+
+    def dec(self, n: float = 1.0) -> None:
+        self.labels().inc(-n)
+
+    def collect(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items:
+            items = [((), 0.0)]
+        return [
+            f"{self.name}{_label_str(k)} {_fmt(v)}" for k, v in items
+        ]
+
+
+class _BoundGauge:
+    __slots__ = ("_m", "_k")
+
+    def __init__(self, metric: Gauge, key: Tuple):
+        self._m = metric
+        self._k = key
+
+    def set(self, v: float) -> None:
+        with self._m._lock:
+            self._m._values[self._k] = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._m._lock:
+            self._m._values[self._k] = self._m._values.get(self._k, 0.0) + n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        label_names: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        # per label key: (bucket counts, sum, count)
+        self._values: Dict[Tuple, Tuple[List[int], float, int]] = {}
+
+    def labels(self, **labels: str) -> "_BoundHistogram":
+        return _BoundHistogram(self, _label_key(labels))
+
+    def observe(self, v: float) -> None:
+        self.labels().observe(v)
+
+    def collect(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        out: List[str] = []
+        for key, (counts, total, n) in items:
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                lk = dict(key)
+                lk["le"] = _fmt(b)
+                out.append(
+                    f"{self.name}_bucket{_label_str(_label_key(lk))} {cum}"
+                )
+            lk = dict(key)
+            lk["le"] = "+Inf"
+            out.append(
+                f"{self.name}_bucket{_label_str(_label_key(lk))} {n}"
+            )
+            out.append(f"{self.name}_sum{_label_str(key)} {_fmt(total)}")
+            out.append(f"{self.name}_count{_label_str(key)} {n}")
+        return out
+
+
+class _BoundHistogram:
+    __slots__ = ("_m", "_k")
+
+    def __init__(self, metric: Histogram, key: Tuple):
+        self._m = metric
+        self._k = key
+
+    def observe(self, v: float) -> None:
+        m = self._m
+        with m._lock:
+            counts, total, n = m._values.get(
+                self._k, ([0] * len(m.buckets), 0.0, 0)
+            )
+            for i, b in enumerate(m.buckets):
+                if v <= b:
+                    counts[i] += 1
+                    break
+            m._values[self._k] = (counts, total + v, n + 1)
+
+
+class Registry:
+    """Collects metrics and renders the text exposition format."""
+
+    def __init__(self):
+        self._metrics: List[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            if any(m.name == metric.name for m in self._metrics):
+                raise ValueError(f"duplicate metric {metric.name}")
+            self._metrics.append(metric)
+        return metric
+
+    def counter(self, name: str, help_: str, labels: Sequence[str] = ()) -> Counter:
+        return self.register(Counter(name, help_, labels))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str, labels: Sequence[str] = ()) -> Gauge:
+        return self.register(Gauge(name, help_, labels))  # type: ignore[return-value]
+
+    def histogram(
+        self,
+        name: str,
+        help_: str,
+        labels: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self.register(Histogram(name, help_, labels, buckets))  # type: ignore[return-value]
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+# --- per-subsystem metric structs (metrics.gen.go analogs) -------------------
+
+
+def _name(subsystem: str, name: str) -> str:
+    return f"{NAMESPACE}_{subsystem}_{name}"
+
+
+class _NopMixin:
+    """Shared, cached no-op instance per metrics class (NOP_LOGGER's
+    pattern): library construction without a registry costs one
+    allocation total, not a throwaway registry per component."""
+
+    @classmethod
+    def nop(cls):
+        inst = cls.__dict__.get("_nop_instance")
+        if inst is None:
+            inst = cls(None)
+            cls._nop_instance = inst
+        return inst
+
+
+class ConsensusMetrics(_NopMixin):
+    """internal/consensus/metrics.gen.go (core subset)."""
+
+    def __init__(self, reg: Optional[Registry]):
+        reg = reg or Registry()
+        s = "consensus"
+        self.height = reg.gauge(_name(s, "height"), "Height of the chain.")
+        self.rounds = reg.gauge(
+            _name(s, "rounds"), "Number of rounds at the latest height."
+        )
+        self.validators = reg.gauge(
+            _name(s, "validators"), "Number of validators."
+        )
+        self.missing_validators = reg.gauge(
+            _name(s, "missing_validators"),
+            "Number of validators who did not sign the last block.",
+        )
+        self.byzantine_validators = reg.gauge(
+            _name(s, "byzantine_validators"),
+            "Number of validators who tried to double sign.",
+        )
+        self.block_interval_seconds = reg.histogram(
+            _name(s, "block_interval_seconds"),
+            "Time between this and the last block.",
+        )
+        self.num_txs = reg.gauge(
+            _name(s, "num_txs"), "Number of transactions in the latest block."
+        )
+        self.block_size_bytes = reg.gauge(
+            _name(s, "block_size_bytes"), "Size of the latest block in bytes."
+        )
+        self.total_txs = reg.counter(
+            _name(s, "total_txs"), "Total number of transactions committed."
+        )
+        self.wal_writes = reg.counter(
+            _name(s, "wal_writes"), "Consensus WAL records written."
+        )
+
+
+
+class P2PMetrics(_NopMixin):
+    """internal/p2p/metrics.gen.go (core subset)."""
+
+    def __init__(self, reg: Optional[Registry]):
+        reg = reg or Registry()
+        s = "p2p"
+        self.peers = reg.gauge(_name(s, "peers"), "Number of connected peers.")
+        self.message_receive_bytes_total = reg.counter(
+            _name(s, "message_receive_bytes_total"),
+            "Total bytes received from peers.",
+            labels=("chID",),
+        )
+        self.message_send_bytes_total = reg.counter(
+            _name(s, "message_send_bytes_total"),
+            "Total bytes sent to peers.",
+            labels=("chID",),
+        )
+
+
+
+class MempoolMetrics(_NopMixin):
+    """internal/mempool/metrics.gen.go (core subset)."""
+
+    def __init__(self, reg: Optional[Registry]):
+        reg = reg or Registry()
+        s = "mempool"
+        self.size = reg.gauge(
+            _name(s, "size"), "Number of uncommitted transactions."
+        )
+        self.tx_size_bytes = reg.histogram(
+            _name(s, "tx_size_bytes"),
+            "Transaction sizes in bytes.",
+            buckets=(1, 16, 64, 256, 1024, 4096, 16384, 65536, 262144, 1048576),
+        )
+        self.failed_txs = reg.counter(
+            _name(s, "failed_txs"), "Number of failed CheckTx."
+        )
+        self.evicted_txs = reg.counter(
+            _name(s, "evicted_txs"), "Number of evicted transactions."
+        )
+
+
+
+class StateMetrics(_NopMixin):
+    """internal/state/metrics.gen.go."""
+
+    def __init__(self, reg: Optional[Registry]):
+        reg = reg or Registry()
+        s = "state"
+        self.block_processing_time = reg.histogram(
+            _name(s, "block_processing_time"),
+            "Time spent processing FinalizeBlock, seconds.",
+        )
+        self.consensus_param_updates = reg.counter(
+            _name(s, "consensus_param_updates"),
+            "Number of consensus parameter updates by the application.",
+        )
+        self.validator_set_updates = reg.counter(
+            _name(s, "validator_set_updates"),
+            "Number of validator set updates by the application.",
+        )
+

@@ -57,11 +57,15 @@ class TxMempool:
         app_client: AbciClient,
         height: int = 0,
         now: Optional[Callable[[], float]] = None,
+        metrics=None,
     ):
+        from tendermint_tpu.libs.metrics import MempoolMetrics
+
         self.config = config
         self.app = app_client
         self.height = height
         self._now = now or _time.monotonic
+        self.metrics = metrics or MempoolMetrics.nop()
         self._mtx = threading.RLock()
         self.cache = (
             LRUTxCache(config.cache_size) if config.cache_size > 0 else NopTxCache()
@@ -124,9 +128,12 @@ class TxMempool:
         if not res.is_ok():
             if not self.config.keep_invalid_txs_in_cache:
                 self.cache.remove(key)
+            self.metrics.failed_txs.inc()
             return res
         with self._mtx:
             self._add_new_transaction(tx, key, res, sender)
+            self.metrics.size.set(len(self._by_key))
+        self.metrics.tx_size_bytes.observe(len(tx))
         return res
 
     def _add_new_transaction(
@@ -171,6 +178,8 @@ class TxMempool:
             for v in to_evict:
                 self._remove(v.hash)
                 self.cache.remove(v.hash)
+            if to_evict:
+                self.metrics.evicted_txs.inc(len(to_evict))
         self._by_key[key] = wtx
         if sender:
             self._by_sender[sender] = wtx
@@ -268,6 +277,7 @@ class TxMempool:
             self._recheck_transactions()
         if self._notify_available and self._by_key:
             self._txs_available_event.set()
+        self.metrics.size.set(len(self._by_key))
 
     def _purge_expired(self, block_height: int) -> None:
         """mempool.go:735-759: TTL by age and by blocks."""
@@ -287,6 +297,8 @@ class TxMempool:
         for key in expired:
             self._remove(key)
             self.cache.remove(key)
+        if expired:
+            self.metrics.evicted_txs.inc(len(expired))
 
     def _recheck_transactions(self) -> None:
         """mempool.go:662-712: re-run CheckTx on survivors after a block."""

@@ -70,6 +70,9 @@ class NodeConfig:
     priv_validator_laddr: str = ""
     # How long node construction waits for the signer to dial in.
     signer_connect_timeout: float = 60.0
+    # Structured logging level: debug/info/warn/error/none (libs/log).
+    # "none" keeps embedded/test nodes silent; the CLI defaults to info.
+    log_level: str = "none"
     # State sync (config/config.go StateSyncConfig): None disables.
     statesync: Optional["StateSyncConfig"] = None
 
@@ -183,8 +186,29 @@ class Node:
             self._dbs.append(idx_db)
             self.indexer = KVIndexer(idx_db)
 
+        # --- observability (node.go:158-184 metrics, libs/log) ----------------
+        from tendermint_tpu.libs.log import Logger
+        from tendermint_tpu.libs.metrics import (
+            ConsensusMetrics,
+            MempoolMetrics,
+            P2PMetrics,
+            Registry,
+            StateMetrics,
+        )
+
+        self.metrics_registry = Registry()
+        self.logger = Logger(
+            level=config.log_level or "none", moniker=config.moniker
+        )
+        consensus_metrics = ConsensusMetrics(self.metrics_registry)
+        mempool_metrics = MempoolMetrics(self.metrics_registry)
+        p2p_metrics = P2PMetrics(self.metrics_registry)
+        state_metrics = StateMetrics(self.metrics_registry)
+
         # --- pools + executor (node.go:258-297) ------------------------------
-        self.mempool = TxMempool(config.mempool, app_client)
+        self.mempool = TxMempool(
+            config.mempool, app_client, metrics=mempool_metrics
+        )
         self.evidence_pool = EvidencePool(
             state_store=self.state_store, block_store=self.block_store
         )
@@ -196,6 +220,7 @@ class Node:
             mempool=self.mempool,
             evidence_pool=self.evidence_pool,
             event_publisher=self._fire_events,
+            metrics=state_metrics,
         )
 
         # --- ABCI handshake (node.go:422 -> replay.go:204-550) ----------------
@@ -228,7 +253,13 @@ class Node:
         self.peer_manager = PeerManager(
             self.node_key.node_id, max_connected=config.max_connections
         )
-        self.router = Router(self.node_info, self.peer_manager, transport)
+        self.router = Router(
+            self.node_info,
+            self.peer_manager,
+            transport,
+            metrics=p2p_metrics,
+            logger=self.logger,
+        )
 
         # --- consensus (node.go:297-325) -------------------------------------
         wal: WAL
@@ -242,6 +273,8 @@ class Node:
             self.block_store,
             priv_validator=self.priv_validator,
             wal=wal,
+            metrics=consensus_metrics,
+            logger=self.logger,
         )
         self.consensus.event_bus = self.event_bus
         self.consensus_reactor = ConsensusReactor(self.consensus, self.router)
@@ -312,7 +345,10 @@ class Node:
             )
             self.rpc_env = env
             self.rpc_server = RPCServer(
-                env.routes(), host=host or "127.0.0.1", port=int(port)
+                env.routes(),
+                host=host or "127.0.0.1",
+                port=int(port),
+                metrics_registry=self.metrics_registry,
             )
         self._started = False
 

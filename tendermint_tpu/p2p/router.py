@@ -63,10 +63,17 @@ class Router:
         node_info: NodeInfo,
         peer_manager: PeerManager,
         transport: Transport,
+        metrics=None,
+        logger=None,
     ):
+        from tendermint_tpu.libs.log import NOP_LOGGER
+        from tendermint_tpu.libs.metrics import P2PMetrics
+
         self.node_info = node_info
         self.peer_manager = peer_manager
         self.transport = transport
+        self.metrics = metrics or P2PMetrics.nop()
+        self.logger = (logger or NOP_LOGGER).with_fields(module="p2p")
         self._channels: Dict[int, Channel] = {}
         self._peer_conns: Dict[NodeID, Connection] = {}
         self._peer_send_queues: Dict[NodeID, "queue.Queue"] = {}
@@ -176,6 +183,9 @@ class Router:
         self._spawn(self._send_peer, f"router-send-{peer_id[:8]}", peer_id, conn, send_q)
         self._spawn(self._receive_peer, f"router-recv-{peer_id[:8]}", peer_id, conn)
         self.peer_manager.ready(peer_id)
+        with self._mtx:
+            self.metrics.peers.set(len(self._peer_conns))
+        self.logger.info("peer connected", peer=peer_id[:16])
 
     # --- per-peer routines ----------------------------------------------------
 
@@ -190,6 +200,9 @@ class Router:
                 return
             try:
                 conn.send(env.channel_id, env.message)
+                self.metrics.message_send_bytes_total.labels(
+                    chID=str(env.channel_id)
+                ).inc(len(env.message))
             except Exception:
                 self._disconnect(peer_id)
                 return
@@ -202,6 +215,9 @@ class Router:
             except (ConnectionClosed, Exception):
                 self._disconnect(peer_id)
                 return
+            self.metrics.message_receive_bytes_total.labels(
+                chID=str(channel_id)
+            ).inc(len(msg))
             ch = self._channels.get(channel_id)
             if ch is None:
                 continue  # unknown channel: drop (router logs in reference)
@@ -216,6 +232,9 @@ class Router:
         with self._mtx:
             conn = self._peer_conns.pop(peer_id, None)
             sq = self._peer_send_queues.pop(peer_id, None)
+            self.metrics.peers.set(len(self._peer_conns))
+        if conn is not None:
+            self.logger.info("peer disconnected", peer=peer_id[:16])
         if conn is not None:
             conn.close()
             if sq is not None:

@@ -43,8 +43,18 @@ INTERNAL_ERROR = -32603
 class RPCServer:
     """Threaded HTTP JSON-RPC server bound to a route table."""
 
-    def __init__(self, routes: Dict[str, Callable], host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        routes: Dict[str, Callable],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics_registry=None,
+    ):
         self.routes = routes
+        # Prometheus text exposition at GET /metrics (the reference serves
+        # this on a dedicated instrumentation port, node/node.go:575-605;
+        # here the RPC listener is the one operator-facing HTTP surface).
+        self.metrics_registry = metrics_registry
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -72,6 +82,19 @@ class RPCServer:
                 method = parsed.path.strip("/")
                 if method == "":
                     self._send(200, server._index().encode())
+                    return
+                if method == "metrics" and server.metrics_registry is not None:
+                    body = server.metrics_registry.expose().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    try:
+                        self.wfile.write(body)
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
                     return
                 params: Dict[str, Any] = {}
                 for k, v in parse_qsl(parsed.query):

@@ -90,7 +90,11 @@ class BlockExecutor:
         evidence_pool: Optional[EvidencePool] = None,
         event_publisher: Optional[Callable] = None,
         now: Optional[Callable[[], Timestamp]] = None,
+        metrics=None,
     ):
+        from tendermint_tpu.libs.metrics import StateMetrics
+
+        self.metrics = metrics or StateMetrics.nop()
         self.state_store = state_store
         self.app = app_client
         self.block_store = block_store
@@ -217,6 +221,7 @@ class BlockExecutor:
             self.validate_block(state, block)
         except ValueError as e:
             raise InvalidBlockError(str(e)) from e
+        _t0 = _time.monotonic()
         fres = self.app.finalize_block(
             abci.RequestFinalizeBlock(
                 hash=block.hash(),
@@ -229,12 +234,18 @@ class BlockExecutor:
                 next_validators_hash=block.header.next_validators_hash,
             )
         )
+        # execution.go:222 block-processing latency metric
+        self.metrics.block_processing_time.observe(_time.monotonic() - _t0)
         self.state_store.save_finalize_block_response(
             block.header.height, _marshal_finalize_response(fres)
         )
         validator_updates = _validate_validator_updates(
             fres.validator_updates, state.consensus_params
         )
+        if validator_updates:
+            self.metrics.validator_set_updates.inc()
+        if fres.consensus_param_updates is not None:
+            self.metrics.consensus_param_updates.inc()
         results_hash = merkle.hash_from_byte_slices(
             [r.deterministic_bytes() for r in fres.tx_results]
         )

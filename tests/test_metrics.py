@@ -1,0 +1,185 @@
+"""Metrics + structured logging tests (metricsgen/libs-log analogs).
+
+Instrument semantics, Prometheus text exposition, the logger's level and
+field behavior, and a live node serving real consensus metrics over
+``GET /metrics``.
+"""
+
+import io
+import json
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.libs.log import Logger, NOP_LOGGER
+from tendermint_tpu.libs.metrics import (
+    ConsensusMetrics,
+    Counter,
+    Gauge,
+    Histogram,
+    MempoolMetrics,
+    Registry,
+)
+
+
+class TestInstruments:
+    def test_counter(self):
+        c = Counter("test_total", "help")
+        c.inc()
+        c.inc(2.5)
+        assert c.collect() == ["test_total 3.5"]
+        with pytest.raises(ValueError):
+            c.labels().inc(-1)
+
+    def test_counter_labels(self):
+        c = Counter("reqs_total", "help", ("code",))
+        c.labels(code="200").inc()
+        c.labels(code="200").inc()
+        c.labels(code="500").inc()
+        assert c.collect() == [
+            'reqs_total{code="200"} 2',
+            'reqs_total{code="500"} 1',
+        ]
+
+    def test_gauge(self):
+        g = Gauge("height", "help")
+        g.set(10)
+        g.inc()
+        g.dec(3)
+        assert g.collect() == ["height 8"]
+
+    def test_histogram(self):
+        h = Histogram("lat", "help", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        lines = h.collect()
+        assert 'lat_bucket{le="0.1"} 1' in lines
+        assert 'lat_bucket{le="1"} 3' in lines
+        assert 'lat_bucket{le="10"} 4' in lines
+        assert 'lat_bucket{le="+Inf"} 5' in lines
+        assert "lat_count 5" in lines
+        assert any(line.startswith("lat_sum ") for line in lines)
+
+    def test_registry_exposition_and_duplicates(self):
+        reg = Registry()
+        reg.counter("a_total", "first")
+        reg.gauge("b", "second")
+        with pytest.raises(ValueError):
+            reg.counter("a_total", "again")
+        text = reg.expose()
+        assert "# HELP a_total first" in text
+        assert "# TYPE a_total counter" in text
+        assert "# TYPE b gauge" in text
+        assert text.endswith("\n")
+
+    def test_subsystem_structs_register(self):
+        reg = Registry()
+        ConsensusMetrics(reg)
+        MempoolMetrics(reg)
+        text = reg.expose()
+        assert "tendermint_consensus_height" in text
+        assert "tendermint_mempool_size" in text
+
+    def test_nop_costs_nothing_visible(self):
+        m = ConsensusMetrics.nop()
+        m.height.set(5)  # must not raise, registers nowhere
+        m.total_txs.inc()
+
+
+class TestLogger:
+    def test_levels_filter(self):
+        sink = io.StringIO()
+        log = Logger(level="warn", sink=sink)
+        log.debug("d")
+        log.info("i")
+        log.warn("w")
+        log.error("e")
+        out = sink.getvalue()
+        assert "WRN w" in out and "ERR e" in out
+        assert "INF" not in out and "DBG" not in out
+
+    def test_fields_and_kv(self):
+        sink = io.StringIO()
+        log = Logger(level="info", sink=sink, moniker="n0")
+        log.with_fields(module="consensus").info(
+            "committed block", height=5, hash=b"\xab\xcd" * 16
+        )
+        line = sink.getvalue().strip()
+        assert "committed block" in line
+        assert "height=5" in line
+        assert "module=consensus" in line
+        assert "moniker=n0" in line
+        assert "abcd" in line  # bytes render as truncated hex
+
+    def test_spaces_quoted(self):
+        sink = io.StringIO()
+        Logger(level="info", sink=sink).info("msg", err="two words")
+        assert 'err="two words"' in sink.getvalue()
+
+    def test_nop_logger_silent_and_chainable(self):
+        NOP_LOGGER.with_fields(a=1).error("nothing happens")
+
+    def test_bad_level_rejected(self):
+        with pytest.raises(ValueError):
+            Logger(level="loud")
+
+    def test_dead_sink_never_raises(self):
+        class Dead:
+            def write(self, s):
+                raise OSError("gone")
+
+        Logger(level="info", sink=Dead()).info("still fine")
+
+
+class TestLiveNodeMetrics:
+    def test_metrics_endpoint_reflects_consensus(self, tmp_path):
+        from tendermint_tpu.abci.client import LocalClient
+        from tendermint_tpu.abci.kvstore import KVStoreApplication
+        from tendermint_tpu.node.node import Node, NodeConfig
+        from tendermint_tpu.privval.file_pv import FilePV
+        from tests.test_node import CHAIN, fast_genesis, wait_for
+
+        pv = FilePV.generate(
+            str(tmp_path / "pk.json"), str(tmp_path / "ps.json")
+        )
+        node = Node(
+            NodeConfig(
+                chain_id=CHAIN,
+                blocksync=False,
+                wal_enabled=False,
+                rpc_laddr="127.0.0.1:0",
+            ),
+            fast_genesis([pv]),
+            LocalClient(KVStoreApplication()),
+            priv_validator=pv,
+        )
+        node.start()
+        try:
+            assert wait_for(lambda: node.height >= 2, timeout=30)
+            node.submit_tx(b"metrics=on")
+            assert wait_for(
+                lambda: node.height >= 4, timeout=30
+            )
+            with urllib.request.urlopen(
+                f"{node.rpc_server.url}/metrics", timeout=5
+            ) as resp:
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                text = resp.read().decode()
+            metrics = {}
+            for line in text.splitlines():
+                if line.startswith("#") or not line.strip():
+                    continue
+                name, _, value = line.rpartition(" ")
+                metrics[name] = float(value)
+            assert metrics["tendermint_consensus_height"] >= 2
+            assert metrics["tendermint_consensus_validators"] == 1
+            assert metrics["tendermint_consensus_total_txs"] >= 1
+            assert metrics["tendermint_state_block_processing_time_count"] >= 2
+            # wal_enabled=False -> NilWAL: the counter must NOT report
+            # writes that were never persisted
+            assert metrics["tendermint_consensus_wal_writes"] == 0
+            assert metrics["tendermint_consensus_block_size_bytes"] > 0
+            assert "tendermint_mempool_size" in metrics
+            assert "tendermint_p2p_peers" in metrics
+        finally:
+            node.stop()
