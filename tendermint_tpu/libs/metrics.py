@@ -162,7 +162,12 @@ class Histogram(_Metric):
 
     def collect(self) -> List[str]:
         with self._lock:
-            items = sorted(self._values.items())
+            # deep-copy counts: observe() mutates the aliased list in
+            # place, and a torn snapshot yields non-monotonic buckets
+            items = sorted(
+                (k, (list(c), t, n))
+                for k, (c, t, n) in self._values.items()
+            )
         out: List[str] = []
         for key, (counts, total, n) in items:
             cum = 0

@@ -349,6 +349,7 @@ class Node:
                 host=host or "127.0.0.1",
                 port=int(port),
                 metrics_registry=self.metrics_registry,
+                event_bus=self.event_bus,
             )
         self._started = False
 

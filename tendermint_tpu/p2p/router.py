@@ -235,7 +235,6 @@ class Router:
             self.metrics.peers.set(len(self._peer_conns))
         if conn is not None:
             self.logger.info("peer disconnected", peer=peer_id[:16])
-        if conn is not None:
             conn.close()
             if sq is not None:
                 try:

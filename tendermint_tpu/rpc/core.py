@@ -18,8 +18,9 @@ from typing import Any, Callable, Dict, List, Optional
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu import eventbus as eb
 from tendermint_tpu.libs.pubsub import Query, QueryError
+from tendermint_tpu.crypto import merkle
 from tendermint_tpu.rpc import encoding as enc
-from tendermint_tpu.rpc.server import INVALID_PARAMS, RPCError
+from tendermint_tpu.rpc.server import INTERNAL_ERROR, INVALID_PARAMS, RPCError
 
 
 def _to_bytes_param(v: Any) -> bytes:
@@ -111,6 +112,8 @@ class Environment:
             "broadcast_evidence": self.broadcast_evidence,
             "events": self.events,
             "subscribe": self.subscribe_poll,
+            "genesis_chunked": self.genesis_chunked,
+            "remove_tx": self.remove_tx,
         }
 
     # -- info routes ----------------------------------------------------------
@@ -522,13 +525,74 @@ class Environment:
         tr = self.indexer.get_tx(h)
         if tr is None:
             raise RPCError(INVALID_PARAMS, f"tx not found: {h.hex()}")
-        return {
+        out = {
             "hash": enc.hex_bytes(h),
             "height": str(tr.height),
             "index": tr.index,
             "tx_result": enc.exec_tx_result_json(tr.result),
             "tx": enc.b64(tr.tx),
         }
+        if prove:
+            # types/tx.go Txs.Proof: merkle inclusion over per-tx hashes
+            # (the leaves of Data.hash); rpc/core/tx.go:52.
+            block = self.block_store.load_block(tr.height)
+            if block is None:
+                raise RPCError(
+                    INVALID_PARAMS, f"block at height {tr.height} pruned"
+                )
+            from tendermint_tpu.types.block import tx_hash as _tx_hash
+
+            leaves = [_tx_hash(t) for t in block.data.txs]
+            root, proofs = merkle.proofs_from_byte_slices(leaves)
+            if tr.index >= len(proofs):
+                raise RPCError(INTERNAL_ERROR, "tx index out of range")
+            p = proofs[tr.index]
+            out["proof"] = {
+                "root_hash": enc.hex_bytes(root),
+                "data": enc.b64(tr.tx),
+                "proof": {
+                    "total": str(p.total),
+                    "index": str(p.index),
+                    "leaf_hash": enc.b64(p.leaf_hash),
+                    "aunts": [enc.b64(a) for a in p.aunts],
+                },
+            }
+        return out
+
+    def genesis_chunked(self, chunk=0) -> Dict[str, Any]:
+        """rpc/core/net.go GenesisChunked: the genesis doc in 16 MiB
+        base64 chunks, for documents too large for one response.
+        Chunks are computed once and cached — the doc is immutable and
+        re-serializing a huge genesis per request defeats the point."""
+        chunks = getattr(self, "_genesis_chunks", None)
+        if chunks is None:
+            data = self.genesis.to_json().encode()
+            size = 16 * 1024 * 1024
+            chunks = [
+                data[i : i + size] for i in range(0, len(data), size)
+            ] or [b""]
+            self._genesis_chunks = chunks
+        c = int(chunk)
+        if c < 0 or c >= len(chunks):
+            raise RPCError(
+                INVALID_PARAMS,
+                f"there are {len(chunks)} chunks, cannot fetch chunk {c}",
+            )
+        return {
+            "chunk": str(c),
+            "total": str(len(chunks)),
+            "data": enc.b64(chunks[c]),
+        }
+
+    def remove_tx(self, tx_key=None) -> Dict[str, Any]:
+        """rpc/core/mempool.go RemoveTx: evict by tx key (sha256 of tx)."""
+        if tx_key is None:
+            raise RPCError(INVALID_PARAMS, "tx_key required")
+        key = _to_bytes_param(tx_key)
+        if len(key) != 32:
+            raise RPCError(INVALID_PARAMS, "tx_key must be 32 bytes")
+        self.mempool.remove_tx_by_key(key)
+        return {}
 
     def tx_search(self, query=None, page=1, per_page=30, order_by="asc") -> Dict[str, Any]:
         if self.indexer is None:
@@ -588,6 +652,18 @@ class Environment:
         res = self.app.query(
             abci.RequestQuery(data=raw, path=path, height=int(height), prove=bool(prove))
         )
+        proof_ops = None
+        if res.proof_ops:
+            proof_ops = {
+                "ops": [
+                    {
+                        "type": getattr(op, "type", ""),
+                        "key": enc.b64(getattr(op, "key", b"")),
+                        "data": enc.b64(getattr(op, "data", b"")),
+                    }
+                    for op in res.proof_ops
+                ]
+            }
         return {
             "response": {
                 "code": res.code,
@@ -596,6 +672,7 @@ class Environment:
                 "index": str(res.index),
                 "key": enc.b64(res.key),
                 "value": enc.b64(res.value),
+                "proof_ops": proof_ops,
                 "height": str(res.height),
                 "codespace": res.codespace,
             }

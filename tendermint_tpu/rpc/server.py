@@ -49,12 +49,15 @@ class RPCServer:
         host: str = "127.0.0.1",
         port: int = 0,
         metrics_registry=None,
+        event_bus=None,
     ):
         self.routes = routes
         # Prometheus text exposition at GET /metrics (the reference serves
         # this on a dedicated instrumentation port, node/node.go:575-605;
         # here the RPC listener is the one operator-facing HTTP surface).
         self.metrics_registry = metrics_registry
+        # event bus backing websocket subscribe/unsubscribe (routes.go:31-34)
+        self.event_bus = event_bus
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -80,6 +83,26 @@ class RPCServer:
             def do_GET(self):
                 parsed = urlparse(self.path)
                 method = parsed.path.strip("/")
+                if method == "websocket":
+                    from tendermint_tpu.rpc import websocket as ws
+
+                    if not ws.is_upgrade_request(self.headers):
+                        self._send(400, b'{"error": "websocket upgrade required"}')
+                        return
+                    key = self.headers["Sec-WebSocket-Key"]
+                    self.send_response_only(101)
+                    self.send_header("Upgrade", "websocket")
+                    self.send_header("Connection", "Upgrade")
+                    self.send_header(
+                        "Sec-WebSocket-Accept", ws.accept_key(key)
+                    )
+                    self.end_headers()
+                    conn = ws.WSConn(self.rfile, self.wfile)
+                    ws.WSSession(
+                        conn, server.routes, server.event_bus
+                    ).run()
+                    self.close_connection = True
+                    return
                 if method == "":
                     self._send(200, server._index().encode())
                     return

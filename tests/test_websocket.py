@@ -1,0 +1,290 @@
+"""Websocket subscribe + new RPC route tests.
+
+A minimal RFC 6455 client (handshake + masked frames, as clients must
+mask) drives the /websocket endpoint of a live node: subscribe to
+NewBlock and Tx events, observe pushes, unsubscribe, and exercise normal
+routes over the socket. Plus genesis_chunked, remove_tx, and
+proof-carrying /tx responses over plain HTTP.
+"""
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import time
+
+import pytest
+
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.crypto.merkle import Proof
+from tendermint_tpu.node.node import Node, NodeConfig
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.rpc.client import HTTPClient
+from tests.test_node import CHAIN, fast_genesis, wait_for
+
+
+class WSClient:
+    """Tiny masked-frame websocket client for tests."""
+
+    def __init__(self, host: str, port: int, path: str = "/websocket"):
+        self.sock = socket.create_connection((host, port), timeout=10)
+        key = base64.b64encode(os.urandom(16)).decode()
+        req = (
+            f"GET {path} HTTP/1.1\r\n"
+            f"Host: {host}:{port}\r\n"
+            "Upgrade: websocket\r\n"
+            "Connection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n"
+        )
+        self.sock.sendall(req.encode())
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("handshake failed")
+            resp += chunk
+        status = resp.split(b"\r\n", 1)[0]
+        assert b"101" in status, status
+        expect = base64.b64encode(
+            hashlib.sha1(
+                (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode()
+            ).digest()
+        ).decode()
+        assert f"Sec-WebSocket-Accept: {expect}".encode() in resp
+        self._buf = b""
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("closed")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def send_text(self, text: str) -> None:
+        payload = text.encode()
+        mask = os.urandom(4)
+        hdr = bytearray([0x81])
+        n = len(payload)
+        if n < 126:
+            hdr.append(0x80 | n)
+        elif n < 1 << 16:
+            hdr.append(0x80 | 126)
+            hdr += struct.pack(">H", n)
+        else:
+            hdr.append(0x80 | 127)
+            hdr += struct.pack(">Q", n)
+        hdr += mask
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.sock.sendall(bytes(hdr) + masked)
+
+    def recv_json(self, timeout: float = 10.0):
+        self.sock.settimeout(timeout)
+        while True:
+            hdr = self._read_exact(2)
+            opcode = hdr[0] & 0x0F
+            length = hdr[1] & 0x7F
+            if length == 126:
+                (length,) = struct.unpack(">H", self._read_exact(2))
+            elif length == 127:
+                (length,) = struct.unpack(">Q", self._read_exact(8))
+            payload = self._read_exact(length)
+            if opcode == 0x1:
+                return json.loads(payload.decode())
+            if opcode == 0x8:
+                return None
+            # ignore ping/pong from server (it shouldn't send any)
+
+    def call(self, method: str, params=None, rid=1):
+        self.send_text(
+            json.dumps(
+                {
+                    "jsonrpc": "2.0",
+                    "id": rid,
+                    "method": method,
+                    "params": params or {},
+                }
+            )
+        )
+        return self.recv_json()
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture(scope="module")
+def ws_node(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("wsnode")
+    pv = FilePV.generate(str(tmp / "pk.json"), str(tmp / "ps.json"))
+    node = Node(
+        NodeConfig(
+            chain_id=CHAIN,
+            blocksync=False,
+            wal_enabled=False,
+            rpc_laddr="127.0.0.1:0",
+        ),
+        fast_genesis([pv]),
+        LocalClient(KVStoreApplication()),
+        priv_validator=pv,
+    )
+    node.start()
+    assert wait_for(lambda: node.height >= 1, timeout=30)
+    host, port = node.rpc_server.address
+    yield node, host, port
+    node.stop()
+
+
+class TestWebsocket:
+    def test_subscribe_new_block(self, ws_node):
+        node, host, port = ws_node
+        ws = WSClient(host, port)
+        try:
+            ack = ws.call(
+                "subscribe", {"query": "tm.event = 'NewBlock'"}, rid=7
+            )
+            assert ack["id"] == 7 and "result" in ack
+            push = ws.recv_json(timeout=30)
+            assert push["id"] == 7
+            assert push["result"]["query"] == "tm.event = 'NewBlock'"
+            assert push["result"]["data"]["type"] == "new_block"
+            height = int(push["result"]["data"]["height"])
+            assert height >= 1
+            # events map carries the composite keys
+            assert "tm.event" in push["result"]["events"]
+        finally:
+            ws.close()
+
+    def test_subscribe_tx_event(self, ws_node):
+        node, host, port = ws_node
+        ws = WSClient(host, port)
+        try:
+            ws.call("subscribe", {"query": "tm.event = 'Tx'"}, rid=9)
+            node.submit_tx(b"ws=push")
+            push = ws.recv_json(timeout=30)
+            assert push["id"] == 9
+            data = push["result"]["data"]
+            assert data["type"] == "tx"
+            assert base64.b64decode(data["tx"]) == b"ws=push"
+        finally:
+            ws.close()
+
+    def test_unsubscribe_stops_pushes(self, ws_node):
+        node, host, port = ws_node
+        ws = WSClient(host, port)
+        try:
+            ws.call("subscribe", {"query": "tm.event = 'NewBlock'"}, rid=1)
+            assert ws.recv_json(timeout=30)["id"] == 1  # at least one push
+            resp = ws.call(
+                "unsubscribe", {"query": "tm.event = 'NewBlock'"}, rid=2
+            )
+            assert "result" in resp
+            # drain anything in flight, then require silence
+            ws.sock.settimeout(2.5)
+            quiet_after_drain = False
+            try:
+                deadline = time.monotonic() + 2.0
+                while time.monotonic() < deadline:
+                    ws.recv_json(timeout=1.0)
+            except (socket.timeout, ConnectionError):
+                quiet_after_drain = True
+            assert quiet_after_drain
+        finally:
+            ws.close()
+
+    def test_normal_routes_over_ws(self, ws_node):
+        node, host, port = ws_node
+        ws = WSClient(host, port)
+        try:
+            resp = ws.call("status", rid=3)
+            assert (
+                int(resp["result"]["sync_info"]["latest_block_height"]) >= 1
+            )
+            resp = ws.call("abci_info", rid=4)
+            assert "response" in resp["result"]
+            resp = ws.call("no_such_method", rid=5)
+            assert resp["error"]["code"] == -32601
+        finally:
+            ws.close()
+
+    def test_plain_get_on_websocket_path_rejected(self, ws_node):
+        import urllib.error
+        import urllib.request
+
+        node, host, port = ws_node
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{host}:{port}/websocket", timeout=5
+            )
+        assert ei.value.code == 400
+
+
+class TestNewRoutes:
+    def test_genesis_chunked(self, ws_node):
+        node, host, port = ws_node
+        client = HTTPClient(node.rpc_server.url)
+        out = client.call("genesis_chunked", {"chunk": 0})
+        assert out["total"] == "1" and out["chunk"] == "0"
+        doc = json.loads(base64.b64decode(out["data"]))
+        assert doc["chain_id"] == CHAIN
+        with pytest.raises(Exception):
+            client.call("genesis_chunked", {"chunk": 99})
+
+    def test_tx_with_proof(self, ws_node):
+        node, host, port = ws_node
+        client = HTTPClient(node.rpc_server.url)
+        tx = b"prove=me"
+        node.submit_tx(tx)
+        from tendermint_tpu.types.block import tx_hash
+
+        h = tx_hash(tx)
+        assert wait_for(
+            lambda: _tx_indexed(client, h), timeout=30
+        ), "tx never indexed"
+        out = client.call(
+            "tx", {"hash": "0x" + h.hex(), "prove": True}
+        )
+        proof_doc = out["proof"]
+        p = proof_doc["proof"]
+        proof = Proof(
+            total=int(p["total"]),
+            index=int(p["index"]),
+            leaf_hash=base64.b64decode(p["leaf_hash"]),
+            aunts=[base64.b64decode(a) for a in p["aunts"]],
+        )
+        root = bytes.fromhex(proof_doc["root_hash"].lower())
+        # the proof must verify against the block's data hash with the
+        # tx hash as leaf (types/tx.go Txs.Proof semantics)
+        assert proof.verify(root, h)
+        blk = client.call("block", {"height": int(out["height"])})
+        assert blk["block"]["header"]["data_hash"].lower() == root.hex()
+
+    def test_remove_tx(self, ws_node):
+        node, host, port = ws_node
+        client = HTTPClient(node.rpc_server.url)
+        from tendermint_tpu.types.block import tx_hash
+
+        tx = b"remove=me-%d" % time.time_ns()
+        # inject directly into the mempool only (bypass consensus timing)
+        node.mempool.check_tx(tx)
+        key = tx_hash(tx)
+        assert any(t == tx for t in node.mempool.tx_list())
+        client.call("remove_tx", {"tx_key": "0x" + key.hex()})
+        assert all(t != tx for t in node.mempool.tx_list())
+        with pytest.raises(Exception):
+            client.call("remove_tx", {"tx_key": "0xdead"})
+
+
+def _tx_indexed(client, h) -> bool:
+    try:
+        client.call("tx", {"hash": "0x" + h.hex()})
+        return True
+    except Exception:
+        return False
