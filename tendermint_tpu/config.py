@@ -56,6 +56,8 @@ class P2PConfig:
     laddr: str = "127.0.0.1:26656"
     persistent_peers: List[str] = dc_field(default_factory=list)
     max_connections: int = 16
+    send_rate: int = 5120000  # bytes/sec per peer (config.go SendRate)
+    recv_rate: int = 5120000
 
 
 @dataclass
@@ -134,6 +136,8 @@ class Config:
             priv_validator_laddr=self.privval.laddr,
             signer_connect_timeout=self.privval.connect_timeout,
             log_level=self.base.log_level,
+            p2p_send_rate=self.p2p.send_rate,
+            p2p_recv_rate=self.p2p.recv_rate,
         )
 
     # --- TOML ---------------------------------------------------------------

@@ -73,6 +73,9 @@ class NodeConfig:
     # Structured logging level: debug/info/warn/error/none (libs/log).
     # "none" keeps embedded/test nodes silent; the CLI defaults to info.
     log_level: str = "none"
+    # Per-peer connection rate limits (config.go P2P SendRate/RecvRate).
+    p2p_send_rate: int = 5120000
+    p2p_recv_rate: int = 5120000
     # State sync (config/config.go StateSyncConfig): None disables.
     statesync: Optional["StateSyncConfig"] = None
 
@@ -240,7 +243,15 @@ class Node:
             if memory_network is not None:
                 transport = memory_network.transport(config.listen_addr)
             else:
-                transport = TCPTransport(self.node_key)
+                from tendermint_tpu.p2p.mconn import MConnConfig
+
+                transport = TCPTransport(
+                    self.node_key,
+                    mconn_config=MConnConfig(
+                        send_rate=config.p2p_send_rate,
+                        recv_rate=config.p2p_recv_rate,
+                    ),
+                )
                 transport.listen(config.listen_addr)
         self.transport = transport
         listen_addr = getattr(transport, "listen_addr", config.listen_addr)

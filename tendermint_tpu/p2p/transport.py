@@ -203,11 +203,29 @@ class _SocketStream:
 
 
 class _TCPConn(Connection):
-    def __init__(self, sock: socket.socket, node_key: NodeKey):
+    """Encrypted TCP connection with MConnection multiplexing on top.
+
+    SecretConnection authenticates and frames the stream; after the
+    NodeInfo handshake the MConnection layer takes over every frame,
+    adding per-channel priority scheduling, ~1400B packetization,
+    send/recv rate limiting, and ping/pong keepalive
+    (transport_mconn.go + conn/connection.go).
+    """
+
+    def __init__(
+        self,
+        sock: socket.socket,
+        node_key: NodeKey,
+        mconn_config=None,
+    ):
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
         self._secret = SecretConnection(_SocketStream(sock), node_key.priv_key)
         self._send_lock = threading.Lock()
+        self._mconn_config = mconn_config
+        self._mconn = None
+        self._recv_q: "queue.Queue" = queue.Queue(maxsize=8192)
+        self._closed_ev = threading.Event()
         self.remote_node_id = node_id_from_pubkey(self._secret.remote_pubkey)
 
     def handshake(self, local_info: NodeInfo) -> NodeInfo:
@@ -221,23 +239,56 @@ class _TCPConn(Connection):
                 f"peer claimed node id {info.node_id} but transport "
                 f"authenticated {self.remote_node_id}"
             )
+        # Handshake done: the multiplexer owns the stream from here.
+        from tendermint_tpu.p2p.mconn import MConnection
+
+        self._mconn = MConnection(
+            send_frame=self._secret.send_msg,
+            recv_frame=self._secret.recv_msg,
+            on_receive=self._deliver,
+            on_error=self._conn_error,
+            config=self._mconn_config,
+        )
+        self._mconn.start()
         return info
 
+    def _deliver(self, channel_id: int, msg: bytes) -> None:
+        if self._closed_ev.is_set():
+            return
+        try:
+            self._recv_q.put((channel_id, msg), timeout=5)
+        except queue.Full:
+            pass  # backpressure: drop (router-side queues do the same)
+
+    def _conn_error(self, e: Exception) -> None:
+        # event, not an in-queue sentinel: a full queue can never lose it
+        self._closed_ev.set()
+
     def send(self, channel_id: int, msg: bytes) -> None:
-        with self._send_lock:
-            self._secret.send_msg(struct.pack("<H", channel_id) + msg)
+        mconn = self._mconn
+        if mconn is None:
+            raise ConnectionClosed("send before handshake")
+        if self._closed_ev.is_set() or mconn.errored or mconn.stopped:
+            # dead connection must surface so the router evicts the peer
+            raise ConnectionClosed("mconn errored or closed")
+        # full channel queue -> drop, matching the reference's
+        # non-blocking Send-returns-false contract (connection.go Send);
+        # gossip routines re-offer what a peer still lacks.
+        mconn.send(channel_id, msg)
 
     def receive(self) -> Tuple[int, bytes]:
-        try:
-            raw = self._secret.recv_msg()
-        except (OSError, Exception) as e:
-            raise ConnectionClosed(str(e)) from e
-        if len(raw) < 2:
-            raise ConnectionClosed("short message")
-        (channel_id,) = struct.unpack_from("<H", raw)
-        return channel_id, raw[2:]
+        # drain anything already delivered, then surface the close
+        while True:
+            try:
+                return self._recv_q.get(timeout=0.2)
+            except queue.Empty:
+                if self._closed_ev.is_set():
+                    raise ConnectionClosed("mconn errored or closed") from None
 
     def close(self) -> None:
+        if self._mconn is not None:
+            self._mconn.stop()
+        self._closed_ev.set()
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -246,8 +297,9 @@ class _TCPConn(Connection):
 
 
 class TCPTransport(Transport):
-    def __init__(self, node_key: NodeKey):
+    def __init__(self, node_key: NodeKey, mconn_config=None):
         self.node_key = node_key
+        self.mconn_config = mconn_config
         self._listener: Optional[socket.socket] = None
         self.listen_addr = ""
 
@@ -265,13 +317,13 @@ class TCPTransport(Transport):
             raise RuntimeError("not listening")
         self._listener.settimeout(timeout)
         sock, _ = self._listener.accept()
-        return _TCPConn(sock, self.node_key)
+        return _TCPConn(sock, self.node_key, mconn_config=self.mconn_config)
 
     def dial(self, addr: str) -> Connection:
         host, _, port = addr.rpartition(":")
         sock = socket.create_connection((host, int(port)), timeout=5)
         sock.settimeout(None)
-        return _TCPConn(sock, self.node_key)
+        return _TCPConn(sock, self.node_key, mconn_config=self.mconn_config)
 
     def close(self) -> None:
         if self._listener is not None:

@@ -161,8 +161,6 @@ class WSSession:
         with self._ids:
             self._next_id[0] += 1
             self.subscriber = f"ws-{self._next_id[0]}"
-        self._subs: Dict[str, threading.Thread] = {}
-        self._lock = threading.Lock()
 
     # --- main loop ----------------------------------------------------------
 
@@ -262,12 +260,9 @@ class WSSession:
                     self.conn.close()
                     return
 
-        t = threading.Thread(
+        threading.Thread(
             target=pump, name=f"{self.subscriber}-pump", daemon=True
-        )
-        t.start()
-        with self._lock:
-            self._subs[query] = t
+        ).start()
 
 
 def _events_json(events) -> Dict[str, list]:
