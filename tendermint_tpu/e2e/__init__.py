@@ -1,0 +1,12 @@
+"""End-to-end test harness: manifest-driven multi-process testnets.
+
+The test/e2e analog: TOML manifests describe a topology, the runner
+stages setup -> start -> load -> perturb -> wait -> test -> stop, and
+invariant checks run against the live network over RPC only
+(test/e2e/README.md:60-80, runner/).
+"""
+
+from tendermint_tpu.e2e.manifest import Manifest, NodeManifest
+from tendermint_tpu.e2e.runner import Runner
+
+__all__ = ["Manifest", "NodeManifest", "Runner"]

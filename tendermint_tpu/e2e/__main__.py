@@ -1,0 +1,5 @@
+"""``python -m tendermint_tpu.e2e <manifest.toml>`` (test/e2e/runner)."""
+
+from tendermint_tpu.e2e.runner import main
+
+raise SystemExit(main())

@@ -1,0 +1,105 @@
+"""E2E testnet manifests (test/e2e/pkg/manifest.go analog).
+
+A manifest is a TOML document: one ``[testnet]`` table plus a
+``[node.<name>]`` table per node::
+
+    [testnet]
+    chain_id = "ci"
+    load_tx_per_sec = 5
+
+    [node.validator0]
+
+    [node.validator1]
+    perturb = ["kill", "pause"]
+
+    [node.full0]
+    mode = "full"
+    start_at = 5          # join late (exercises block sync)
+    db_backend = "filedb"
+
+Node options mirror the reference manifest knobs that apply here:
+mode (validator|full), start_at, db_backend, perturb list
+(kill|pause|restart — disconnect needs packet-level control the harness
+doesn't have), proxy_app (kvstore|persistent_kvstore), and
+privval ("file" | "remote" for an out-of-process signer).
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+VALID_MODES = ("validator", "full")
+VALID_PERTURBATIONS = ("kill", "pause", "restart")
+
+
+@dataclass
+class NodeManifest:
+    name: str
+    mode: str = "validator"
+    start_at: int = 0  # 0 = from genesis
+    db_backend: str = "filedb"
+    proxy_app: str = "kvstore"
+    privval: str = "file"
+    perturb: List[str] = field(default_factory=list)
+
+    def validate(self) -> None:
+        if self.mode not in VALID_MODES:
+            raise ValueError(f"node {self.name}: invalid mode {self.mode!r}")
+        for p in self.perturb:
+            if p not in VALID_PERTURBATIONS:
+                raise ValueError(
+                    f"node {self.name}: invalid perturbation {p!r} "
+                    f"(valid: {VALID_PERTURBATIONS})"
+                )
+        if self.start_at < 0:
+            raise ValueError(f"node {self.name}: negative start_at")
+        if self.privval not in ("file", "remote"):
+            raise ValueError(
+                f"node {self.name}: invalid privval {self.privval!r}"
+            )
+
+
+@dataclass
+class Manifest:
+    chain_id: str = "e2e-net"
+    initial_height: int = 1
+    load_tx_per_sec: float = 2.0
+    wait_heights: int = 6  # heights to advance during the wait stage
+    nodes: Dict[str, NodeManifest] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, text: str) -> "Manifest":
+        doc = tomllib.loads(text)
+        tn = doc.get("testnet", {})
+        m = cls(
+            chain_id=tn.get("chain_id", "e2e-net"),
+            initial_height=int(tn.get("initial_height", 1)),
+            load_tx_per_sec=float(tn.get("load_tx_per_sec", 2.0)),
+            wait_heights=int(tn.get("wait_heights", 6)),
+        )
+        for name, spec in doc.get("node", {}).items():
+            nm = NodeManifest(name=name)
+            for key in (
+                "mode",
+                "start_at",
+                "db_backend",
+                "proxy_app",
+                "privval",
+                "perturb",
+            ):
+                if key in spec:
+                    setattr(nm, key, spec[key])
+            nm.validate()
+            m.nodes[name] = nm
+        if not m.nodes:
+            raise ValueError("manifest has no nodes")
+        if not any(n.mode == "validator" for n in m.nodes.values()):
+            raise ValueError("manifest needs at least one validator")
+        return m
+
+    @classmethod
+    def load(cls, path: str) -> "Manifest":
+        with open(path, "rb") as fh:
+            return cls.parse(fh.read().decode())

@@ -1,0 +1,453 @@
+"""E2E runner: stage a manifest's testnet through its lifecycle.
+
+test/e2e/runner analog. Stages (runner/main.go order):
+
+  setup    generate per-node homes (config.toml, shared genesis, keys)
+  start    spawn one ``python -m tendermint_tpu start`` per node
+           (start_at > 0 nodes join late and block-sync the gap)
+  load     background transaction generator over RPC
+           (runner/load.go)
+  perturb  kill -9 / SIGSTOP+SIGCONT / SIGTERM-restart per manifest
+           (runner/perturb.go:42-72)
+  wait     every running node advances ``wait_heights`` past the start
+  test     invariants over RPC only: heights advance, block hashes agree
+           at every common height, app hashes agree, txs committed
+           (test/e2e/tests/{block,app,net}_test.go)
+  stop     SIGTERM everything, collect exit codes
+
+Runnable: ``python -m tendermint_tpu.e2e <manifest.toml>``.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tendermint_tpu.config import Config
+from tendermint_tpu.e2e.manifest import Manifest, NodeManifest
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+class E2EError(Exception):
+    pass
+
+
+@dataclass
+class _Node:
+    manifest: NodeManifest
+    home: str
+    p2p_port: int
+    rpc_port: int
+    proc: Optional[subprocess.Popen] = None
+    log_path: str = ""
+
+    @property
+    def rpc_url(self) -> str:
+        return f"http://127.0.0.1:{self.rpc_port}"
+
+    def rpc(self, method: str, params: Optional[dict] = None, timeout=5.0):
+        req = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "id": 1,
+                "method": method,
+                "params": params or {},
+            }
+        ).encode()
+        with urllib.request.urlopen(
+            urllib.request.Request(
+                self.rpc_url, req, {"Content-Type": "application/json"}
+            ),
+            timeout=timeout,
+        ) as resp:
+            doc = json.load(resp)
+        if "error" in doc:
+            raise E2EError(f"{method}: {doc['error']}")
+        return doc["result"]
+
+    def height(self) -> int:
+        return int(self.rpc("status")["sync_info"]["latest_block_height"])
+
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+def _free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class Runner:
+    def __init__(self, manifest: Manifest, workdir: str, log=print):
+        self.manifest = manifest
+        self.workdir = workdir
+        self.log = log
+        self.nodes: Dict[str, _Node] = {}
+        self._load_proc_stop = False
+        self._sent_txs: List[bytes] = []
+        self.failures: List[str] = []
+
+    # --- setup ---------------------------------------------------------------
+
+    def setup(self) -> None:
+        """runner/setup.go: homes, keys, shared genesis, peer wiring."""
+        from tendermint_tpu.encoding.canonical import Timestamp
+        from tendermint_tpu.p2p.key import NodeKey
+        from tendermint_tpu.privval.file_pv import FilePV
+        from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+        from tendermint_tpu.types.params import ConsensusParams, TimeoutParams
+
+        names = list(self.manifest.nodes)
+        ports = _free_ports(2 * len(names))
+        pvs, node_keys = {}, {}
+        for i, name in enumerate(names):
+            nm = self.manifest.nodes[name]
+            home = os.path.join(self.workdir, name)
+            node = _Node(
+                manifest=nm,
+                home=home,
+                p2p_port=ports[2 * i],
+                rpc_port=ports[2 * i + 1],
+                log_path=os.path.join(self.workdir, f"{name}.log"),
+            )
+            cfg = Config(home=home)
+            cfg.base.moniker = name
+            cfg.base.db_backend = nm.db_backend
+            cfg.base.proxy_app = nm.proxy_app
+            cfg.p2p.laddr = f"127.0.0.1:{node.p2p_port}"
+            cfg.rpc.laddr = f"127.0.0.1:{node.rpc_port}"
+            os.makedirs(cfg.config_dir(), exist_ok=True)
+            os.makedirs(cfg.data_dir(), exist_ok=True)
+            node_keys[name] = NodeKey.load_or_gen(cfg.node_key_file())
+            pvs[name] = FilePV.load_or_generate(
+                cfg.privval_key_file(), cfg.privval_state_file()
+            )
+            self.nodes[name] = node
+            node._cfg = cfg  # type: ignore[attr-defined]
+
+        params = ConsensusParams()
+        params.timeout = TimeoutParams(
+            propose=0.8, propose_delta=0.2, vote=0.4, vote_delta=0.1,
+            commit=0.2,
+        )
+        genesis = GenesisDoc(
+            chain_id=self.manifest.chain_id,
+            genesis_time=Timestamp.from_unix_ns(time.time_ns()),
+            initial_height=self.manifest.initial_height,
+            consensus_params=params,
+            validators=[
+                GenesisValidator(pub_key=pvs[n].get_pub_key(), power=10)
+                for n in names
+                if self.manifest.nodes[n].mode == "validator"
+            ],
+        )
+        peers = [
+            f"{node_keys[n].node_id}@127.0.0.1:{self.nodes[n].p2p_port}"
+            for n in names
+        ]
+        for i, name in enumerate(names):
+            cfg = self.nodes[name]._cfg  # type: ignore[attr-defined]
+            cfg.p2p.persistent_peers = [
+                p for j, p in enumerate(peers) if j != i
+            ]
+            cfg.save()
+            genesis.save_as(cfg.genesis_file())
+        self.log(f"setup: {len(names)} node homes under {self.workdir}")
+
+    # --- start/stop ----------------------------------------------------------
+
+    def _spawn(self, node: _Node) -> None:
+        log_fh = open(node.log_path, "ab")
+        node.proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "tendermint_tpu",
+                "--home",
+                node.home,
+                "start",
+            ],
+            cwd=REPO_ROOT,
+            stdout=log_fh,
+            stderr=subprocess.STDOUT,
+        )
+
+    def start(self) -> None:
+        """Start genesis nodes; late joiners start in wait()."""
+        for name, node in self.nodes.items():
+            if node.manifest.start_at == 0:
+                self._spawn(node)
+                self.log(f"start: {name} (rpc :{node.rpc_port})")
+        self._wait_all_up(
+            [n for n in self.nodes.values() if n.manifest.start_at == 0]
+        )
+
+    def _wait_all_up(self, nodes: List[_Node], timeout: float = 60) -> None:
+        deadline = time.monotonic() + timeout
+        for node in nodes:
+            while True:
+                try:
+                    node.height()
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise E2EError(
+                            f"node {node.manifest.name} rpc never came up "
+                            f"(log: {node.log_path})"
+                        )
+                    time.sleep(0.5)
+
+    def stop(self) -> None:
+        for node in self.nodes.values():
+            if node.proc is not None and node.proc.poll() is None:
+                node.proc.send_signal(signal.SIGTERM)
+        for node in self.nodes.values():
+            if node.proc is not None:
+                try:
+                    node.proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    node.proc.kill()
+
+    # --- load ----------------------------------------------------------------
+
+    def load(self, duration: float) -> int:
+        """runner/load.go: steady tx stream against round-robin nodes."""
+        rate = self.manifest.load_tx_per_sec
+        if rate <= 0:
+            return 0
+        targets = [
+            n for n in self.nodes.values()
+            if n.running() and n.manifest.start_at == 0
+        ]
+        sent = 0
+        deadline = time.monotonic() + duration
+        seq = 0
+        while time.monotonic() < deadline:
+            node = targets[seq % len(targets)]
+            tx = f"load-{seq}={os.urandom(4).hex()}".encode()
+            seq += 1
+            try:
+                node.rpc(
+                    "broadcast_tx_sync",
+                    {"tx": base64.b64encode(tx).decode()},
+                )
+                self._sent_txs.append(tx)
+                sent += 1
+            except Exception:
+                pass  # nodes may be mid-perturbation
+            time.sleep(1.0 / rate)
+        self.log(f"load: sent {sent} txs")
+        return sent
+
+    # --- perturb -------------------------------------------------------------
+
+    def perturb(self) -> None:
+        """runner/perturb.go:42-72: one perturbation at a time, waiting
+        for recovery after each."""
+        for name, node in self.nodes.items():
+            for p in node.manifest.perturb:
+                self.log(f"perturb: {p} {name}")
+                if p == "kill":
+                    node.proc.kill()
+                    node.proc.wait(timeout=10)
+                    time.sleep(1.0)
+                    self._spawn(node)
+                elif p == "restart":
+                    node.proc.send_signal(signal.SIGTERM)
+                    try:
+                        node.proc.wait(timeout=15)
+                    except subprocess.TimeoutExpired:
+                        node.proc.kill()
+                        node.proc.wait(timeout=5)
+                    self._spawn(node)
+                elif p == "pause":
+                    node.proc.send_signal(signal.SIGSTOP)
+                    time.sleep(3.0)
+                    node.proc.send_signal(signal.SIGCONT)
+                self._wait_recovery(node)
+
+    def _wait_recovery(self, node: _Node, timeout: float = 90) -> None:
+        """Node serves RPC and its height advances again."""
+        deadline = time.monotonic() + timeout
+        base = None
+        while time.monotonic() < deadline:
+            try:
+                h = node.height()
+                if base is None:
+                    base = h
+                elif h > base:
+                    self.log(f"perturb: {node.manifest.name} recovered at {h}")
+                    return
+            except Exception:
+                pass
+            time.sleep(0.5)
+        raise E2EError(f"{node.manifest.name} did not recover")
+
+    # --- wait + late joiners -------------------------------------------------
+
+    def wait(self, timeout: float = 180) -> None:
+        """Every node reaches start height + wait_heights; late joiners
+        start once the chain passes their start_at and must catch up."""
+        running = [
+            n for n in self.nodes.values() if n.manifest.start_at == 0
+        ]
+        target = max(n.height() for n in running) + self.manifest.wait_heights
+        late = [n for n in self.nodes.values() if n.manifest.start_at > 0]
+        deadline = time.monotonic() + timeout
+        started_late = set()
+        while time.monotonic() < deadline:
+            heights = {}
+            for node in self.nodes.values():
+                if node.proc is None:
+                    continue
+                try:
+                    heights[node.manifest.name] = node.height()
+                except Exception:
+                    heights[node.manifest.name] = -1
+            chain_h = max((h for h in heights.values()), default=0)
+            for node in late:
+                if (
+                    node.manifest.name not in started_late
+                    and chain_h >= node.manifest.start_at
+                ):
+                    self.log(
+                        f"start: late joiner {node.manifest.name} "
+                        f"at chain height {chain_h}"
+                    )
+                    self._spawn(node)
+                    started_late.add(node.manifest.name)
+            if all(h >= target for h in heights.values()) and len(
+                heights
+            ) == len(self.nodes):
+                self.log(f"wait: all nodes >= {target} {heights}")
+                return
+            time.sleep(1.0)
+        raise E2EError(
+            f"wait: nodes never reached {target}: "
+            f"{ {n: h for n, h in heights.items()} }"
+        )
+
+    # --- invariants ----------------------------------------------------------
+
+    def test(self) -> None:
+        """tests/{block,app,net}_test.go: RPC-only invariant checks."""
+        nodes = [n for n in self.nodes.values() if n.running()]
+        if len(nodes) < 2:
+            raise E2EError("fewer than two nodes running at test stage")
+
+        # net_test.go: everyone has peers
+        for node in nodes:
+            n_peers = int(node.rpc("net_info")["n_peers"])
+            if n_peers < 1:
+                self.failures.append(
+                    f"{node.manifest.name}: no peers connected"
+                )
+
+        # block_test.go: block ids agree at every common height
+        statuses = {n.manifest.name: n.rpc("status") for n in nodes}
+        earliest = max(
+            int(s["sync_info"]["earliest_block_height"])
+            for s in statuses.values()
+        )
+        latest_common = min(
+            int(s["sync_info"]["latest_block_height"])
+            for s in statuses.values()
+        )
+        if latest_common < earliest:
+            self.failures.append("no common heights between nodes")
+        step = max(1, (latest_common - earliest) // 10)
+        for h in range(earliest, latest_common + 1, step):
+            ids = {
+                n.manifest.name: n.rpc("block", {"height": h})["block_id"][
+                    "hash"
+                ]
+                for n in nodes
+            }
+            if len(set(ids.values())) != 1:
+                self.failures.append(f"block id mismatch at {h}: {ids}")
+
+        # app_test.go: app hash agreement at the common tip
+        hashes = {
+            n.manifest.name: n.rpc("block", {"height": latest_common})[
+                "block"
+            ]["header"]["app_hash"]
+            for n in nodes
+        }
+        if len(set(hashes.values())) != 1:
+            self.failures.append(
+                f"app hash mismatch at {latest_common}: {hashes}"
+            )
+
+        # load made it into the chain: spot-check a committed tx
+        committed = 0
+        for tx in self._sent_txs[:20]:
+            h = hashlib.sha256(tx).hexdigest()
+            try:
+                nodes[0].rpc("tx", {"hash": "0x" + h})
+                committed += 1
+            except Exception:
+                pass
+        if self._sent_txs and committed == 0:
+            self.failures.append("none of the load txs committed")
+
+        if self.failures:
+            raise E2EError("; ".join(self.failures))
+        self.log(
+            f"test: invariants ok over heights {earliest}..{latest_common}, "
+            f"{committed} load txs verified committed"
+        )
+
+    # --- full lifecycle ------------------------------------------------------
+
+    def run(self) -> None:
+        try:
+            self.setup()
+            self.start()
+            self.load(duration=3.0)
+            self.perturb()
+            self.load(duration=2.0)
+            self.wait()
+            self.test()
+        finally:
+            self.stop()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import tempfile
+
+    ap = argparse.ArgumentParser(prog="python -m tendermint_tpu.e2e")
+    ap.add_argument("manifest", help="path to a testnet manifest (TOML)")
+    ap.add_argument("--workdir", default="")
+    args = ap.parse_args(argv)
+    manifest = Manifest.load(args.manifest)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="tmtpu-e2e-")
+    runner = Runner(manifest, workdir)
+    try:
+        runner.run()
+    except E2EError as e:
+        print(f"E2E FAILED: {e}", file=sys.stderr)
+        return 1
+    print("E2E PASSED")
+    return 0

@@ -39,8 +39,10 @@ DEFAULT_SEND_RATE = 5120000  # bytes/sec (5MB/s)
 DEFAULT_RECV_RATE = 5120000
 DEFAULT_PING_INTERVAL = 60.0
 DEFAULT_PONG_TIMEOUT = 90.0
+DEFAULT_SEND_TIMEOUT = 10.0  # connection.go:47 defaultSendTimeout
 DEFAULT_SEND_QUEUE_CAPACITY = 1024  # messages per channel
 DEFAULT_RECV_MESSAGE_CAPACITY = 22020096  # 21MB
+MAX_RECV_CHANNELS = 256  # distinct channel states per connection
 
 # Reactor channel priorities, as each reference reactor registers them
 # (consensus/reactor.go:38-68, blocksync:45, mempool:85, evidence:38,
@@ -68,6 +70,7 @@ class MConnConfig:
     recv_rate: int = DEFAULT_RECV_RATE
     ping_interval: float = DEFAULT_PING_INTERVAL
     pong_timeout: float = DEFAULT_PONG_TIMEOUT
+    send_timeout: float = DEFAULT_SEND_TIMEOUT
     send_queue_capacity: int = DEFAULT_SEND_QUEUE_CAPACITY
     recv_message_capacity: int = DEFAULT_RECV_MESSAGE_CAPACITY
     channel_priorities: Dict[int, int] = field(
@@ -87,7 +90,13 @@ class _TokenBucket:
         self._lock = threading.Lock()
 
     def consume(self, n: int, cancelled: threading.Event) -> None:
-        """Block until n tokens are available (sleeping off the deficit)."""
+        """Block until n tokens are available (sleeping off the deficit).
+
+        n is clamped to the bucket capacity: a single packet larger than
+        one second of rate must still eventually pass (paying a full
+        bucket), never deadlock the connection.
+        """
+        n = min(n, int(self.capacity))
         while True:
             with self._lock:
                 now = time.monotonic()
@@ -149,6 +158,8 @@ class MConnection:
         self._frame_lock = threading.Lock()
         self._last_pong = time.monotonic()
         self._ping_outstanding = False
+        self._ping_sent = 0.0
+        self._recv_buffered = 0
         self._threads = []
         self._errored = threading.Event()
 
@@ -199,17 +210,22 @@ class MConnection:
         return self._stop.is_set()
 
     def send(self, channel_id: int, msg: bytes) -> bool:
-        """Enqueue a message; False when the channel queue is full
-        (connection.go Send's non-blocking contract — callers drop)."""
-        if self._stop.is_set():
-            return False
+        """Enqueue a message, blocking up to ``send_timeout`` for queue
+        space; False on timeout or stop (connection.go Send blocks on the
+        channel sendQueue with defaultSendTimeout then reports false)."""
         st = self._chan(channel_id)
-        with self._chan_lock:
-            if len(st.queue) == st.queue.maxlen:
+        deadline = time.monotonic() + self.config.send_timeout
+        while True:
+            if self._stop.is_set():
                 return False
-            st.queue.append(msg)
-        self._send_ready.set()
-        return True
+            with self._chan_lock:
+                if len(st.queue) < st.queue.maxlen:
+                    st.queue.append(msg)
+                    self._send_ready.set()
+                    return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)  # send routine drains continuously
 
     def _pick_channel(self) -> Optional[Tuple[int, _ChannelState]]:
         """Lowest recently_sent/priority among channels with pending data
@@ -292,14 +308,32 @@ class MConnection:
                     raise MConnectionError("short msg packet")
                 cid, eof = struct.unpack_from(">HB", frame, 1)
                 payload = frame[4:]
+                # Channels open dynamically (router reactors), so unknown
+                # ids are accepted — but bounded: a hostile peer spraying
+                # packets across the 64Ki id space must not allocate
+                # unbounded channel states or reassembly buffers
+                # (connection.go instead rejects unregistered channels;
+                # same resource bound, looser coupling).
+                with self._chan_lock:
+                    known = cid in self._channels
+                if not known and len(self._channels) >= MAX_RECV_CHANNELS:
+                    raise MConnectionError(
+                        f"too many distinct channels (> {MAX_RECV_CHANNELS})"
+                    )
                 st = self._chan(cid)
                 st.recv_buf += payload
+                self._recv_buffered += len(payload)
                 if len(st.recv_buf) > self.config.recv_message_capacity:
                     raise MConnectionError(
                         f"message on channel {cid:#x} exceeds recv capacity"
                     )
+                if self._recv_buffered > 3 * self.config.recv_message_capacity:
+                    raise MConnectionError(
+                        "aggregate reassembly buffers exceed capacity"
+                    )
                 if eof:
                     msg = bytes(st.recv_buf)
+                    self._recv_buffered -= len(st.recv_buf)
                     st.recv_buf = bytearray()
                     self._on_receive(cid, msg)
         except Exception as e:
@@ -308,16 +342,28 @@ class MConnection:
     # --- keepalive ----------------------------------------------------------
 
     def _ping_routine(self) -> None:
+        """Ping every ping_interval; the pong clock starts when the
+        unanswered ping was SENT (connection.go arms pongTimeout in
+        sendRoutine), checked at a finer wake so the effective timeout
+        tracks the configured one."""
+        wake = min(
+            self.config.ping_interval, max(0.05, self.config.pong_timeout / 3)
+        )
+        last_ping = 0.0
         try:
-            while not self._stop.wait(self.config.ping_interval):
+            while not self._stop.wait(wake):
+                now = time.monotonic()
                 if (
                     self._ping_outstanding
-                    and time.monotonic() - self._last_pong
-                    > self.config.pong_timeout
+                    and now - self._ping_sent > self.config.pong_timeout
                 ):
                     raise MConnectionError("pong timeout")
-                with self._frame_lock:
-                    self._send_frame(bytes([_PKT_PING]))
-                self._ping_outstanding = True
+                if now - last_ping >= self.config.ping_interval:
+                    with self._frame_lock:
+                        self._send_frame(bytes([_PKT_PING]))
+                    last_ping = now
+                    if not self._ping_outstanding:
+                        self._ping_outstanding = True
+                        self._ping_sent = now
         except Exception as e:
             self._error(e)

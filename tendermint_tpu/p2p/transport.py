@@ -12,7 +12,6 @@ from __future__ import annotations
 import json
 import queue
 import socket
-import struct
 import threading
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, List, Optional, Tuple

@@ -1,0 +1,100 @@
+"""E2E harness tests (test/e2e analog).
+
+Manifest parsing is covered cheaply; the flagship case stages a real
+3-validator multi-process testnet through the full runner lifecycle —
+setup, start, tx load, a kill -9 perturbation with recovery, wait, and
+the RPC-only invariant suite.
+"""
+
+import pytest
+
+from tendermint_tpu.e2e.manifest import Manifest
+from tendermint_tpu.e2e.runner import Runner
+
+
+class TestManifest:
+    def test_parse_full(self):
+        m = Manifest.parse(
+            """
+[testnet]
+chain_id = "x"
+load_tx_per_sec = 1.5
+wait_heights = 3
+
+[node.validator0]
+
+[node.v1]
+perturb = ["kill", "pause", "restart"]
+db_backend = "memdb"
+proxy_app = "persistent_kvstore"
+
+[node.full0]
+mode = "full"
+start_at = 7
+"""
+        )
+        assert m.chain_id == "x"
+        assert m.load_tx_per_sec == 1.5
+        assert set(m.nodes) == {"validator0", "v1", "full0"}
+        assert m.nodes["v1"].perturb == ["kill", "pause", "restart"]
+        assert m.nodes["full0"].mode == "full"
+        assert m.nodes["full0"].start_at == 7
+
+    def test_rejects_bad_perturbation(self):
+        with pytest.raises(ValueError, match="invalid perturbation"):
+            Manifest.parse(
+                "[node.a]\nperturb = ['disconnect']\n"
+            )
+
+    def test_rejects_bad_mode(self):
+        with pytest.raises(ValueError, match="invalid mode"):
+            Manifest.parse("[node.a]\nmode = 'seed'\n")
+
+    def test_rejects_empty(self):
+        with pytest.raises(ValueError, match="no nodes"):
+            Manifest.parse("[testnet]\nchain_id='x'\n")
+
+    def test_rejects_no_validators(self):
+        with pytest.raises(ValueError, match="at least one validator"):
+            Manifest.parse("[node.a]\nmode = 'full'\n")
+
+    def test_ci_manifest_parses(self):
+        import os
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tendermint_tpu",
+            "e2e",
+            "networks",
+            "ci.toml",
+        )
+        m = Manifest.load(path)
+        assert len(m.nodes) == 5
+        assert m.nodes["full0"].start_at == 4
+
+
+class TestRunnerLifecycle:
+    def test_three_validators_with_kill(self, tmp_path):
+        manifest = Manifest.parse(
+            """
+[testnet]
+chain_id = "e2e-pytest"
+load_tx_per_sec = 3.0
+wait_heights = 4
+
+[node.validator0]
+
+[node.validator1]
+perturb = ["kill"]
+
+[node.validator2]
+"""
+        )
+        events = []
+        runner = Runner(manifest, str(tmp_path), log=events.append)
+        runner.run()  # raises E2EError on any stage/invariant failure
+        joined = "\n".join(events)
+        assert "perturb: kill validator1" in joined
+        assert "recovered" in joined
+        assert "invariants ok" in joined
+        assert not runner.failures
