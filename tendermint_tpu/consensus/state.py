@@ -1008,6 +1008,18 @@ class ConsensusState:
         height = self.rs.height
         offset = self.wal.search_for_end_height(height - 1)
         if offset is None and height > self.state.initial_height:
+            pruned_from = getattr(self.wal, "first_offset", lambda: 0)()
+            if pruned_from > 0:
+                # The marker existed but rotation pruned it away: replaying
+                # from the retention horizon would feed stale-height
+                # messages into the state machine. Fatal, as in the
+                # reference (replay.go treats a missing end-height as a
+                # corrupt WAL).
+                raise RuntimeError(
+                    f"WAL end-height marker for {height - 1} was pruned "
+                    f"(retention starts at offset {pruned_from}); cannot "
+                    "safely replay — restore from a snapshot or state sync"
+                )
             offset = 0
         start = offset or 0
         for _, msg in self.wal.iter_messages(start):

@@ -141,52 +141,69 @@ class WALCorruptionError(Exception):
 
 
 class WAL:
-    """File-backed WAL. write() appends; write_sync() additionally fsyncs
-    before returning — used for our own messages (state.go:964)."""
+    """File-backed WAL over a rotating autofile group (wal.go uses
+    autofile.Group the same way): write() appends; write_sync()
+    additionally fsyncs before returning — used for our own messages
+    (state.go:964). Rotation happens at record boundaries so records
+    never span chunks, and replay offsets are LOGICAL offsets — stable
+    across rotation and pruning."""
 
-    def __init__(self, path: str):
+    def __init__(
+        self,
+        path: str,
+        head_size_limit: Optional[int] = None,
+        total_size_limit: Optional[int] = None,
+    ):
+        from tendermint_tpu.libs import autofile
+
         self.path = path
-        self._file = None
+        kwargs = {}
+        if head_size_limit is not None:
+            kwargs["head_size_limit"] = head_size_limit
+        if total_size_limit is not None:
+            kwargs["total_size_limit"] = total_size_limit
+        self._group = autofile.Group(path, **kwargs)
+        self._started = False
 
     def start(self) -> None:
+        self._group.start()
         self._truncate_torn_tail()
-        self._file = open(self.path, "ab")
+        self._started = True
 
     def stop(self) -> None:
-        if self._file is not None:
-            self._file.flush()
-            os.fsync(self._file.fileno())
-            self._file.close()
-            self._file = None
+        if self._started:
+            self._group.stop()
+            self._started = False
 
     def write(self, msg: WALMessage) -> None:
-        if self._file is None:
+        if not self._started:
             raise RuntimeError("WAL not started")
         payload = _encode_payload(msg)
         if len(payload) > MAX_MSG_SIZE_BYTES:
             raise ValueError(f"msg is too big: {len(payload)} bytes")
         rec = struct.pack(">II", zlib.crc32(payload), len(payload)) + payload
-        self._file.write(rec)
+        self._group.write(rec)
+        self._group.maybe_rotate()
 
     def write_sync(self, msg: WALMessage) -> None:
         self.write(msg)
         self.flush_and_sync()
 
     def flush_and_sync(self) -> None:
-        if self._file is not None:
-            self._file.flush()
-            os.fsync(self._file.fileno())
+        if self._started:
+            self._group.flush(sync=True)
 
     # --- reading ------------------------------------------------------------
 
     def _truncate_torn_tail(self) -> None:
-        """Drop a partial final record left by a crash mid-write."""
+        """Drop a partial final record left by a crash mid-write. Only
+        the head can be torn; sealed chunks were fsynced at rotation."""
         if not os.path.exists(self.path):
             return
-        good_end = 0
         with open(self.path, "rb") as f:
             data = f.read()
         pos = 0
+        good_end = 0
         while pos + 8 <= len(data):
             crc, length = struct.unpack_from(">II", data, pos)
             if pos + 8 + length > len(data):
@@ -197,31 +214,39 @@ class WAL:
             pos += 8 + length
             good_end = pos
         if good_end < len(data):
-            with open(self.path, "r+b") as f:
-                f.truncate(good_end)
+            self._group.truncate_head_tail(good_end)
+
+    def first_offset(self) -> int:
+        """Oldest retained logical offset (> 0 once pruning happened)."""
+        return self._group.first_offset()
 
     def iter_messages(
         self, start_offset: int = 0
     ) -> Iterator[Tuple[int, WALMessage]]:
-        """Yield (offset_after_record, message) from start_offset; raises
-        WALCorruptionError on a bad CRC in the interior."""
-        if not os.path.exists(self.path):
-            return
-        with open(self.path, "rb") as f:
-            f.seek(start_offset)
-            data = f.read()
-        pos = 0
-        while pos + 8 <= len(data):
-            crc, length = struct.unpack_from(">II", data, pos)
-            if length > MAX_MSG_SIZE_BYTES:
-                raise WALCorruptionError(f"record length {length} exceeds max")
-            if pos + 8 + length > len(data):
-                return  # torn tail: treat as EOF (crash recovery)
-            payload = data[pos + 8 : pos + 8 + length]
-            if zlib.crc32(payload) != crc:
-                raise WALCorruptionError(f"CRC mismatch at offset {start_offset + pos}")
-            pos += 8 + length
-            yield start_offset + pos, _decode_payload(payload)
+        """Yield (logical_offset_after_record, message) from
+        start_offset; raises WALCorruptionError on a bad CRC in the
+        interior. Offsets below the pruning horizon yield from the
+        oldest retained record. Streams one segment at a time — records
+        never span chunks (rotation happens at record boundaries), so
+        each segment parses independently."""
+        start = max(start_offset, self._group.first_offset())
+        for base, data in self._group.iter_segments_from(start):
+            pos = 0
+            while pos + 8 <= len(data):
+                crc, length = struct.unpack_from(">II", data, pos)
+                if length > MAX_MSG_SIZE_BYTES:
+                    raise WALCorruptionError(
+                        f"record length {length} exceeds max"
+                    )
+                if pos + 8 + length > len(data):
+                    return  # torn tail (head only): EOF, crash recovery
+                payload = data[pos + 8 : pos + 8 + length]
+                if zlib.crc32(payload) != crc:
+                    raise WALCorruptionError(
+                        f"CRC mismatch at offset {base + pos}"
+                    )
+                pos += 8 + length
+                yield base + pos, _decode_payload(payload)
 
     def search_for_end_height(self, height: int) -> Optional[int]:
         """Offset just past #ENDHEIGHT for `height`, or None
