@@ -126,9 +126,49 @@ def _build_node(cfg: Config):
     )
 
 
+def _run_seed(cfg: Config) -> int:
+    """Seed-only mode: PEX address gossip, no chain services."""
+    from tendermint_tpu.node.seed import SeedNode
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    genesis = GenesisDoc.from_file(cfg.genesis_file())
+    seed = SeedNode(
+        home=cfg.config_dir(),
+        chain_id=genesis.chain_id,
+        listen_addr=cfg.p2p.laddr,
+        bootstrap_peers=cfg.p2p.persistent_peers,
+        moniker=cfg.base.moniker,
+        max_connections=cfg.p2p.max_connections,
+        log_level=cfg.base.log_level,
+    )
+    stop = []
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    seed.start()
+    print(
+        f"seed {seed.node_key.node_id} started (p2p {seed.listen_addr})",
+        flush=True,
+    )
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        seed.stop()
+    return 0
+
+
 def cmd_start(args) -> int:
     """commands/run_node.go: assemble and run until SIGINT/SIGTERM."""
     cfg = _load_cfg(args)
+    if cfg.base.mode not in ("full", "seed"):
+        print(
+            f"error: [base] mode must be 'full' or 'seed', "
+            f"got {cfg.base.mode!r}",
+            file=sys.stderr,
+        )
+        return 1
+    if cfg.base.mode == "seed":
+        return _run_seed(cfg)
 
     def _stop(_sig, _frm):
         # raising interrupts even blocking calls (accept() in the signer

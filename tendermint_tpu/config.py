@@ -40,6 +40,9 @@ class BaseConfig:
 
     moniker: str = "tpu-node"
     log_level: str = "info"  # debug/info/warn/error/none
+    # "full" runs the complete node; "seed" runs PEX-only address gossip
+    # (node/seed.go; reference config Mode).
+    mode: str = "full"
     # ABCI application: "kvstore" (in-process), "persistent_kvstore"
     # (filedb-backed, in-process), or "tcp://host:port" for an
     # out-of-process socket app (config.go ProxyApp).

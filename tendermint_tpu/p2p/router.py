@@ -14,6 +14,7 @@ import threading
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, List, Optional
 
+from tendermint_tpu.p2p.conn_tracker import ConnTracker
 from tendermint_tpu.p2p.key import NodeID
 from tendermint_tpu.p2p.peermanager import PeerAddress, PeerManager
 from tendermint_tpu.p2p.transport import (
@@ -65,6 +66,7 @@ class Router:
         transport: Transport,
         metrics=None,
         logger=None,
+        max_incoming_per_ip: int = 16,
     ):
         from tendermint_tpu.libs.log import NOP_LOGGER
         from tendermint_tpu.libs.metrics import P2PMetrics
@@ -77,6 +79,8 @@ class Router:
         self._channels: Dict[int, Channel] = {}
         self._peer_conns: Dict[NodeID, Connection] = {}
         self._peer_send_queues: Dict[NodeID, "queue.Queue"] = {}
+        self._peer_ips: Dict[NodeID, str] = {}
+        self._conn_tracker = ConnTracker(max_per_ip=max_incoming_per_ip)
         self._mtx = threading.RLock()
         self._stop_flag = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -108,6 +112,13 @@ class Router:
             for conn in self._peer_conns.values():
                 conn.close()
             self._peer_conns.clear()
+            self._peer_send_queues.clear()
+            # release per-IP reservations: threads that exit on the stop
+            # flag never reach _disconnect, and stale counts would reject
+            # legitimate inbound after a restart
+            for ip in self._peer_ips.values():
+                self._conn_tracker.remove(ip)
+            self._peer_ips.clear()
         for t in self._threads:
             t.join(timeout=2)
         self._threads.clear()
@@ -120,7 +131,7 @@ class Router:
     # --- accept / dial loops --------------------------------------------------
 
     def _accept_loop(self) -> None:
-        """router.go acceptPeers:444."""
+        """router.go acceptPeers:444 (+ conn_tracker.go per-IP limit)."""
         while not self._stop_flag.is_set():
             try:
                 conn = self.transport.accept(timeout=0.2)
@@ -129,6 +140,14 @@ class Router:
             except Exception:
                 if self._stop_flag.is_set():
                     return
+                continue
+            ip = getattr(conn, "remote_ip", None)
+            if ip is not None and not self._conn_tracker.add(ip):
+                self.logger.info("inbound rejected: per-IP limit", ip=ip)
+                try:
+                    conn.close()
+                except Exception:
+                    pass
                 continue
             self._spawn(self._handshake_peer, "router-handshake", conn, None)
 
@@ -170,16 +189,27 @@ class Router:
         except Exception:
             if dialed is not None:
                 self.peer_manager.dial_failed(dialed)
+            else:
+                ip = getattr(conn, "remote_ip", None)
+                if ip is not None:
+                    self._conn_tracker.remove(ip)
             conn.close()
             return
         peer_id = peer_info.node_id
         send_q: "queue.Queue" = queue.Queue(maxsize=10000)
         with self._mtx:
             old = self._peer_conns.pop(peer_id, None)
+            old_ip = self._peer_ips.pop(peer_id, None)
             if old is not None:
                 old.close()
+            if old_ip is not None:
+                self._conn_tracker.remove(old_ip)
             self._peer_conns[peer_id] = conn
             self._peer_send_queues[peer_id] = send_q
+            if dialed is None:
+                ip = getattr(conn, "remote_ip", None)
+                if ip is not None:
+                    self._peer_ips[peer_id] = ip
         self._spawn(self._send_peer, f"router-send-{peer_id[:8]}", peer_id, conn, send_q)
         self._spawn(self._receive_peer, f"router-recv-{peer_id[:8]}", peer_id, conn)
         self.peer_manager.ready(peer_id)
@@ -204,7 +234,7 @@ class Router:
                     chID=str(env.channel_id)
                 ).inc(len(env.message))
             except Exception:
-                self._disconnect(peer_id)
+                self._disconnect(peer_id, expected_conn=conn)
                 return
 
     def _receive_peer(self, peer_id: NodeID, conn: Connection) -> None:
@@ -213,7 +243,7 @@ class Router:
             try:
                 channel_id, msg = conn.receive()
             except (ConnectionClosed, Exception):
-                self._disconnect(peer_id)
+                self._disconnect(peer_id, expected_conn=conn)
                 return
             self.metrics.message_receive_bytes_total.labels(
                 chID=str(channel_id)
@@ -228,11 +258,23 @@ class Router:
             except queue.Full:
                 pass  # backpressure: drop (priority queues in reference)
 
-    def _disconnect(self, peer_id: NodeID) -> None:
+    def _disconnect(
+        self, peer_id: NodeID, expected_conn: Optional[Connection] = None
+    ) -> None:
+        """Evict peer_id's connection. When ``expected_conn`` is given,
+        only evict if it is still the installed one — a send/recv thread
+        of an OLD connection must not tear down the replacement a
+        reconnect just installed."""
         with self._mtx:
+            current = self._peer_conns.get(peer_id)
+            if expected_conn is not None and current is not expected_conn:
+                return
             conn = self._peer_conns.pop(peer_id, None)
             sq = self._peer_send_queues.pop(peer_id, None)
+            ip = self._peer_ips.pop(peer_id, None)
             self.metrics.peers.set(len(self._peer_conns))
+        if ip is not None:
+            self._conn_tracker.remove(ip)
         if conn is not None:
             self.logger.info("peer disconnected", peer=peer_id[:16])
             conn.close()

@@ -211,26 +211,49 @@ class _TCPConn(Connection):
     (transport_mconn.go + conn/connection.go).
     """
 
+    HANDSHAKE_TIMEOUT = 10.0  # transport_mconn.go handshake deadline
+
     def __init__(
         self,
         sock: socket.socket,
         node_key: NodeKey,
         mconn_config=None,
     ):
+        # NO crypto here: __init__ runs on the accept/dial loop thread,
+        # which must stay responsive. The SecretConnection key exchange
+        # happens in handshake(), on the router's per-peer handshake
+        # thread, under a socket deadline — a client that connects and
+        # sends nothing cannot wedge the accept loop or force key
+        # exchanges past the per-IP limit.
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
-        self._secret = SecretConnection(_SocketStream(sock), node_key.priv_key)
+        self._node_key = node_key
+        self._secret = None
         self._send_lock = threading.Lock()
         self._mconn_config = mconn_config
         self._mconn = None
         self._recv_q: "queue.Queue" = queue.Queue(maxsize=8192)
         self._closed_ev = threading.Event()
-        self.remote_node_id = node_id_from_pubkey(self._secret.remote_pubkey)
+        try:
+            self.remote_ip = sock.getpeername()[0]
+        except OSError:
+            self.remote_ip = None
+        self.remote_node_id = None  # known after handshake()
 
     def handshake(self, local_info: NodeInfo) -> NodeInfo:
-        with self._send_lock:
-            self._secret.send_msg(local_info.to_json_bytes())
-        info = NodeInfo.from_json_bytes(self._secret.recv_msg())
+        self._sock.settimeout(self.HANDSHAKE_TIMEOUT)
+        try:
+            self._secret = SecretConnection(
+                _SocketStream(self._sock), self._node_key.priv_key
+            )
+            self.remote_node_id = node_id_from_pubkey(
+                self._secret.remote_pubkey
+            )
+            with self._send_lock:
+                self._secret.send_msg(local_info.to_json_bytes())
+            info = NodeInfo.from_json_bytes(self._secret.recv_msg())
+        finally:
+            self._sock.settimeout(None)
         # The authenticated transport key must match the claimed node id
         # (transport_mconn.go handshake validation).
         if info.node_id != self.remote_node_id:

@@ -1,0 +1,97 @@
+"""Seed-only node + per-IP connection tracker tests
+(node/seed.go, internal/p2p/conn_tracker.go analogs)."""
+
+import time
+
+import pytest
+
+from tendermint_tpu.node.seed import SeedNode
+from tendermint_tpu.p2p.conn_tracker import ConnTracker
+from tests.test_node import CHAIN, wait_for
+
+
+class TestConnTracker:
+    def test_limits_per_ip(self):
+        t = ConnTracker(max_per_ip=2)
+        assert t.add("1.2.3.4")
+        assert t.add("1.2.3.4")
+        assert not t.add("1.2.3.4")  # third concurrent rejected
+        assert t.add("5.6.7.8")  # other IPs unaffected
+        t.remove("1.2.3.4")
+        assert t.add("1.2.3.4")  # freed slot reusable
+        assert t.count("1.2.3.4") == 2
+        assert t.total() == 3
+
+    def test_remove_below_zero_safe(self):
+        t = ConnTracker(max_per_ip=1)
+        t.remove("9.9.9.9")  # never added: no-op
+        assert t.count("9.9.9.9") == 0
+        assert t.add("9.9.9.9")
+
+
+class TestSeedNode:
+    def test_seed_distributes_addresses(self, tmp_path):
+        """Two full nodes that only know the seed discover each other
+        through PEX and connect directly."""
+        from tendermint_tpu.abci.client import LocalClient
+        from tendermint_tpu.abci.kvstore import KVStoreApplication
+        from tendermint_tpu.node.node import Node, NodeConfig
+        from tendermint_tpu.privval.file_pv import FilePV
+        from tests.test_node import fast_genesis
+
+        seed = SeedNode(
+            home=str(tmp_path / "seed"), chain_id=CHAIN,
+            listen_addr="127.0.0.1:0",
+        )
+        seed.start()
+        try:
+            seed_peer = f"{seed.node_key.node_id}@{seed.listen_addr}"
+            pvs = [
+                FilePV.generate(
+                    str(tmp_path / f"pk{i}.json"),
+                    str(tmp_path / f"ps{i}.json"),
+                )
+                for i in range(2)
+            ]
+            genesis = fast_genesis(pvs)
+            nodes = []
+            for i in range(2):
+                node = Node(
+                    NodeConfig(
+                        chain_id=CHAIN,
+                        listen_addr="127.0.0.1:0",
+                        wal_enabled=False,
+                        persistent_peers=[seed_peer],
+                        moniker=f"n{i}",
+                    ),
+                    genesis,
+                    LocalClient(KVStoreApplication()),
+                    priv_validator=pvs[i],
+                )
+                nodes.append(node)
+            for node in nodes:
+                node.start()
+            try:
+                # both connect to the seed, learn each other over PEX,
+                # dial directly, and (being the 2 validators) commit
+                assert wait_for(
+                    lambda: all(
+                        any(
+                            p != seed.node_key.node_id
+                            for p in n.router.connected_peers()
+                        )
+                        for n in nodes
+                    ),
+                    timeout=30,
+                ), "nodes never discovered each other via the seed"
+                assert wait_for(
+                    lambda: all(n.height >= 1 for n in nodes), timeout=60
+                ), f"heights: {[n.height for n in nodes]}"
+                # the seed never participates in consensus
+                assert not hasattr(seed, "consensus")
+                assert len(seed.connected_peers()) >= 2
+            finally:
+                for node in nodes:
+                    node.stop()
+        finally:
+            seed.stop()
