@@ -181,9 +181,37 @@ class KVIndexer:
         return TxResult.from_json(raw) if raw is not None else None
 
     def search_txs(self, query: Query, limit: int = 100) -> List[TxResult]:
+        """AND-of-conditions search; full records decoded only for the
+        first ``limit`` matches (see search_tx_keys)."""
+        keys = self.search_tx_keys(query)
+        out = []
+        for _, _, h in keys[:limit]:
+            tr = self.get_tx(h)
+            if tr is not None:
+                out.append(tr)
+        return out
+
+    def search_tx_keys(self, query: Query) -> List[tuple]:
         """AND-of-conditions search mirroring tx/kv/kv.go: each condition
         produces a hash set from its index range; results are the
-        intersection, height/index ordered."""
+        intersection as sorted (height, index, hash) triples. (height,
+        index) come from the index keys themselves, so paginating callers
+        can count and order ALL matches without decoding any record —
+        only the requested page pays get_tx (the reference pushes
+        pagination into the kv sink the same way, tx/kv/kv.go)."""
+        positions: dict = {}
+
+        def _note(h: bytes, k: bytes) -> None:
+            if h not in positions:
+                tail = k.rsplit(b"/", 2)
+                if len(tail) == 3:
+                    try:
+                        positions[h] = (int(tail[1]), int(tail[2]))
+                        return
+                    except ValueError:
+                        pass
+                positions[h] = None
+
         hash_sets: List[set] = []
         for cond in query.conditions:
             hashes = set()
@@ -198,12 +226,19 @@ class KVIndexer:
                     h = bytes.fromhex(cond.value)
                 except ValueError:
                     return []
-                hash_sets.append({h} if self.get_tx(h) is not None else set())
+                tr = self.get_tx(h)
+                if tr is not None:
+                    positions[h] = (tr.height, tr.index)
+                    hash_sets.append({h})
+                else:
+                    hash_sets.append(set())
                 continue
             if cond.op == "=":
                 prefix = _TX_EVENT_PREFIX + f"{cond.key}/{cond.value}/".encode()
-                for _, v in _iter_prefix(self.db, prefix):
-                    hashes.add(bytes(v))
+                for k, v in _iter_prefix(self.db, prefix):
+                    h = bytes(v)
+                    hashes.add(h)
+                    _note(h, k)
             elif cond.op in ("<", "<=", ">", ">="):
                 prefix = _TX_EVENT_PREFIX + f"{cond.key}/".encode()
                 bound = float(cond.value)
@@ -221,32 +256,44 @@ class KVIndexer:
                         or (cond.op == ">" and val > bound)
                         or (cond.op == ">=" and val >= bound)
                     ):
-                        hashes.add(bytes(v))
+                        h = bytes(v)
+                        hashes.add(h)
+                        _note(h, k)
             elif cond.op == "CONTAINS":
                 prefix = _TX_EVENT_PREFIX + f"{cond.key}/".encode()
                 for k, v in _iter_prefix(self.db, prefix):
                     parts = k[len(prefix) :].rsplit(b"/", 2)
                     if len(parts) == 3 and cond.value.encode() in parts[0]:
-                        hashes.add(bytes(v))
+                        h = bytes(v)
+                        hashes.add(h)
+                        _note(h, k)
             elif cond.op == "EXISTS":
                 prefix = _TX_EVENT_PREFIX + f"{cond.key}/".encode()
-                for _, v in _iter_prefix(self.db, prefix):
-                    hashes.add(bytes(v))
+                for k, v in _iter_prefix(self.db, prefix):
+                    h = bytes(v)
+                    hashes.add(h)
+                    _note(h, k)
             hash_sets.append(hashes)
         if not hash_sets:
             # query was only tm.event = 'Tx': all indexed txs
             common = set()
-            for _, v in _iter_prefix(self.db, _TX_EVENT_PREFIX + b"tx.height/"):
-                common.add(bytes(v))
+            for k, v in _iter_prefix(self.db, _TX_EVENT_PREFIX + b"tx.height/"):
+                h = bytes(v)
+                common.add(h)
+                _note(h, k)
         else:
             common = set.intersection(*hash_sets)
-        out = []
+        triples = []
         for h in common:
-            tr = self.get_tx(h)
-            if tr is not None:
-                out.append(tr)
-        out.sort(key=lambda t: (t.height, t.index))
-        return out[:limit]
+            pos = positions.get(h)
+            if pos is None:
+                tr = self.get_tx(h)  # rare: unparseable key tail
+                if tr is None:
+                    continue
+                pos = (tr.height, tr.index)
+            triples.append((pos[0], pos[1], h))
+        triples.sort()
+        return triples
 
     def search_block_heights(self, query: Query, limit: int = 100) -> List[int]:
         height_sets: List[set] = []

@@ -54,9 +54,10 @@ class SeedNode:
             self.node_key.node_id, max_connected=max_connections
         )
         for peer in bootstrap_peers or []:
-            node_id, _, addr = peer.partition("@")
-            if node_id and addr:
-                self.peer_manager.add_address(PeerAddress(node_id, addr))
+            # PeerAddress.parse raises on malformed entries — a typo'd
+            # bootstrap peer must fail startup, not leave a silent seed
+            # with an empty address book
+            self.peer_manager.add_address(PeerAddress.parse(peer))
         self.router = Router(
             self.node_info,
             self.peer_manager,
@@ -92,4 +93,4 @@ class SeedNode:
         return list(self.router.connected_peers())
 
     def known_addresses(self) -> int:
-        return len(self.peer_manager.sample_addresses(limit=1_000_000))
+        return self.peer_manager.num_addresses()

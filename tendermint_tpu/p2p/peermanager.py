@@ -137,6 +137,11 @@ class PeerManager:
             peer = self._peers.get(node_id)
             return list(peer.addresses) if peer else []
 
+    def num_addresses(self) -> int:
+        """Total known addresses (cheap count, no materialization)."""
+        with self._mtx:
+            return sum(len(p.addresses) for p in self._peers.values())
+
     def sample_addresses(self, limit: int = 10) -> List[PeerAddress]:
         """For PEX: a sample of known (id, addr) pairs."""
         with self._mtx:

@@ -13,6 +13,7 @@ import json
 import queue
 import socket
 import threading
+import time
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, List, Optional, Tuple
 
@@ -201,6 +202,37 @@ class _SocketStream:
         return buf
 
 
+class _DeadlineStream(_SocketStream):
+    """Stream with an ABSOLUTE deadline: every operation shrinks the
+    socket timeout to the remaining budget, so a peer trickling one byte
+    per timeout window cannot hold the handshake (and its per-IP slot)
+    open indefinitely (transport_mconn.go SetDeadline semantics)."""
+
+    def __init__(self, sock: socket.socket, deadline: float):
+        super().__init__(sock)
+        self._deadline = deadline
+
+    def _arm(self) -> None:
+        remaining = self._deadline - time.monotonic()
+        if remaining <= 0:
+            raise socket.timeout("handshake deadline exceeded")
+        self._sock.settimeout(remaining)
+
+    def sendall(self, data: bytes) -> None:
+        self._arm()
+        super().sendall(data)
+
+    def recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            self._arm()
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionClosed("EOF")
+            buf += chunk
+        return buf
+
+
 class _TCPConn(Connection):
     """Encrypted TCP connection with MConnection multiplexing on top.
 
@@ -241,10 +273,11 @@ class _TCPConn(Connection):
         self.remote_node_id = None  # known after handshake()
 
     def handshake(self, local_info: NodeInfo) -> NodeInfo:
-        self._sock.settimeout(self.HANDSHAKE_TIMEOUT)
+        deadline = time.monotonic() + self.HANDSHAKE_TIMEOUT
         try:
             self._secret = SecretConnection(
-                _SocketStream(self._sock), self._node_key.priv_key
+                _DeadlineStream(self._sock, deadline),
+                self._node_key.priv_key,
             )
             self.remote_node_id = node_id_from_pubkey(
                 self._secret.remote_pubkey
@@ -254,6 +287,8 @@ class _TCPConn(Connection):
             info = NodeInfo.from_json_bytes(self._secret.recv_msg())
         finally:
             self._sock.settimeout(None)
+        # handshake done: swap in the undeadlined stream for steady-state
+        self._secret._stream = _SocketStream(self._sock)
         # The authenticated transport key must match the claimed node id
         # (transport_mconn.go handshake validation).
         if info.node_id != self.remote_node_id:

@@ -601,12 +601,15 @@ class Environment:
             q = Query.parse(query or "")
         except QueryError as e:
             raise RPCError(INVALID_PARAMS, str(e))
-        results = self.indexer.search_txs(q, limit=10000)
+        # Paginate over index keys; only the selected page's records are
+        # decoded (a query matching the whole chain stays O(page)).
+        keys = self.indexer.search_tx_keys(q)
         if order_by == "desc":
-            results = results[::-1]
+            keys = keys[::-1]
         page = max(1, int(page))
         per_page = max(1, min(100, int(per_page)))
-        sel = results[(page - 1) * per_page : (page - 1) * per_page + per_page]
+        sel_keys = keys[(page - 1) * per_page : (page - 1) * per_page + per_page]
+        sel = [self.indexer.get_tx(h) for _, _, h in sel_keys]
         return {
             "txs": [
                 {
@@ -617,8 +620,9 @@ class Environment:
                     "tx": enc.b64(t.tx),
                 }
                 for t in sel
+                if t is not None
             ],
-            "total_count": str(len(results)),
+            "total_count": str(len(keys)),
         }
 
     def block_search(self, query=None, page=1, per_page=30, order_by="asc") -> Dict[str, Any]:

@@ -1,0 +1,81 @@
+"""KV indexer search tests: key-level pagination and ordering
+(internal/state/indexer tx/kv analog)."""
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.indexer.kv import KVIndexer, TxResult
+from tendermint_tpu.libs.pubsub import Query
+from tendermint_tpu.storage import MemDB
+
+
+def _tx(height, index, payload, extra_events=()):
+    events = [
+        abci.Event(
+            type="app",
+            attributes=[
+                abci.EventAttribute(key="kind", value="transfer", index=True)
+            ],
+        )
+    ]
+    events.extend(extra_events)
+    return TxResult(
+        height=height,
+        index=index,
+        tx=payload,
+        result=abci.ExecTxResult(code=0, events=events),
+    )
+
+
+class TestSearchKeys:
+    def _indexed(self, n_heights=20, per_height=5):
+        idx = KVIndexer(MemDB())
+        txs = []
+        for h in range(1, n_heights + 1):
+            for i in range(per_height):
+                txs.append(_tx(h, i, b"tx-%d-%d" % (h, i)))
+        idx.index_txs(txs)
+        return idx, txs
+
+    def test_keys_sorted_and_complete(self):
+        idx, txs = self._indexed()
+        keys = idx.search_tx_keys(Query.parse("app.kind = 'transfer'"))
+        assert len(keys) == len(txs)
+        assert keys == sorted(keys)
+        assert keys[0][:2] == (1, 0)
+        assert keys[-1][:2] == (20, 4)
+
+    def test_page_decodes_only_its_records(self):
+        idx, txs = self._indexed()
+        # search_txs with a small limit must not decode beyond it
+        decoded = []
+        orig = idx.get_tx
+
+        def counting_get(h):
+            decoded.append(h)
+            return orig(h)
+
+        idx.get_tx = counting_get
+        out = idx.search_txs(Query.parse("app.kind = 'transfer'"), limit=7)
+        assert len(out) == 7
+        assert len(decoded) == 7  # exactly the page, not all 100
+        assert [(t.height, t.index) for t in out] == [
+            (1, 0), (1, 1), (1, 2), (1, 3), (1, 4), (2, 0), (2, 1),
+        ]
+
+    def test_height_range_condition(self):
+        idx, _ = self._indexed()
+        keys = idx.search_tx_keys(
+            Query.parse("tx.height >= 18 AND tx.height <= 19")
+        )
+        assert {k[0] for k in keys} == {18, 19}
+        assert len(keys) == 10
+
+    def test_hash_condition(self):
+        idx, txs = self._indexed()
+        h = txs[42].hash()
+        keys = idx.search_tx_keys(Query.parse(f"tx.hash = '{h.hex()}'"))
+        assert len(keys) == 1
+        assert keys[0] == (txs[42].height, txs[42].index, h)
+
+    def test_no_match(self):
+        idx, _ = self._indexed()
+        assert idx.search_tx_keys(Query.parse("app.kind = 'nope'")) == []

@@ -1,0 +1,169 @@
+"""Proposer-based timestamp (PBTS) tests — pbts_test.go analog.
+
+Exercises the timeliness predicate and the prevote decision directly:
+an untimely proposal (timestamp too far in the future, or too old
+relative to receipt) draws a nil prevote from honest validators; timely
+ones are prevoted; the per-round relaxation eventually accepts any
+timestamp; and a proposal whose header time disagrees with the proposal
+timestamp is rejected outright.
+"""
+
+import time
+
+import pytest
+
+from tendermint_tpu.consensus import cstypes
+from tendermint_tpu.consensus.cstypes import RoundStep
+from tendermint_tpu.encoding.canonical import (
+    SIGNED_MSG_TYPE_PREVOTE,
+    Timestamp,
+)
+from tendermint_tpu.types.block import BlockID, PartSetHeader, Proposal
+from tendermint_tpu.types.params import SynchronyParams
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.block import BLOCK_PART_SIZE_BYTES
+
+from tests.test_consensus import BASE_NS, CHAIN_ID, build_validator
+
+NS = 1_000_000_000
+
+
+def _stage_proposal(cs, ts_ns: int, recv_ns: int, round_: int = 0):
+    """Put a complete proposal+block into the round state with the given
+    proposal timestamp and receive time (the way gossip ingestion would)."""
+    rs = cs.rs
+    block = cs.block_exec.create_proposal_block(
+        rs.height, cs.state, cs.rs.last_commit.make_extended_commit()
+        if rs.last_commit is not None
+        else __import__(
+            "tendermint_tpu.types", fromlist=["ExtendedCommit"]
+        ).ExtendedCommit(),
+        cs.state.validators.get_proposer().address,
+    )
+    block.header.time = Timestamp.from_unix_ns(ts_ns)
+    block._hash = None
+    parts = PartSet.from_data(block.to_proto_bytes(), BLOCK_PART_SIZE_BYTES)
+    proposal = Proposal(
+        height=rs.height,
+        round=round_,
+        pol_round=-1,
+        block_id=BlockID(block.hash(), parts.header()),
+        timestamp=Timestamp.from_unix_ns(ts_ns),
+    )
+    rs.round = round_
+    rs.step = RoundStep.PROPOSE
+    rs.proposal = proposal
+    rs.proposal_receive_time = Timestamp.from_unix_ns(recv_ns)
+    rs.proposal_block = block
+    rs.proposal_block_parts = parts
+    return block
+
+
+def _prevote_cast(cs):
+    """Run the prevote decision; return the block hash prevoted (b'' = nil)."""
+    votes = []
+    orig = cs._sign_add_vote
+
+    def capture(type_, block_hash, psh):
+        votes.append((type_, block_hash))
+
+    cs._sign_add_vote = capture
+    try:
+        cs._do_prevote(cs.rs.height, cs.rs.round)
+    finally:
+        cs._sign_add_vote = orig
+    assert votes and votes[0][0] == SIGNED_MSG_TYPE_PREVOTE
+    return votes[0][1]
+
+
+@pytest.fixture()
+def validator(tmp_path):
+    cs, privs, app = build_validator(tmp_path)
+    # deterministic clock for the kernel of these tests
+    sp = cs.state.consensus_params.synchrony
+    assert sp.precision > 0 and sp.message_delay > 0
+    yield cs
+    cs.stop()
+
+
+class TestTimelinessPredicate:
+    def test_exact_receipt_is_timely(self, validator):
+        cs = validator
+        now = time.time_ns()
+        _stage_proposal(cs, ts_ns=now, recv_ns=now)
+        assert cs._proposal_is_timely()
+
+    def test_future_timestamp_untimely(self, validator):
+        cs = validator
+        sp = cs.state.consensus_params.synchrony
+        now = time.time_ns()
+        # proposal claims a time more than PRECISION ahead of receipt
+        ahead = int(sp.precision * NS) + 200_000_000
+        _stage_proposal(cs, ts_ns=now + ahead, recv_ns=now)
+        assert not cs._proposal_is_timely()
+
+    def test_stale_timestamp_untimely(self, validator):
+        cs = validator
+        sp = cs.state.consensus_params.synchrony
+        now = time.time_ns()
+        behind = int((sp.precision + sp.message_delay) * NS) + 200_000_000
+        _stage_proposal(cs, ts_ns=now - behind, recv_ns=now)
+        assert not cs._proposal_is_timely()
+
+    def test_round_relaxation_eventually_accepts(self, validator):
+        """params.go SynchronyParams.InRound: message_delay grows per
+        round so a lagging proposer's timestamp is eventually timely."""
+        cs = validator
+        sp = cs.state.consensus_params.synchrony
+        now = time.time_ns()
+        behind = int((sp.precision + sp.message_delay) * NS) + 500_000_000
+        for round_ in range(0, 60):
+            _stage_proposal(cs, ts_ns=now - behind, recv_ns=now, round_=round_)
+            if cs._proposal_is_timely():
+                assert round_ > 0, "round 0 must reject this stale proposal"
+                return
+        pytest.fail("relaxation never accepted the proposal")
+
+
+class TestPrevoteDecision:
+    def test_timely_proposal_prevoted(self, validator):
+        cs = validator
+        now = time.time_ns()
+        block = _stage_proposal(cs, ts_ns=now, recv_ns=now)
+        assert _prevote_cast(cs) == block.hash()
+
+    def test_untimely_proposal_gets_nil_prevote(self, validator):
+        cs = validator
+        sp = cs.state.consensus_params.synchrony
+        now = time.time_ns()
+        ahead = int(sp.precision * NS) + 500_000_000
+        _stage_proposal(cs, ts_ns=now + ahead, recv_ns=now)
+        assert _prevote_cast(cs) == b""
+
+    def test_header_time_mismatch_gets_nil_prevote(self, validator):
+        """A proposer whose block header time differs from the proposal
+        timestamp is lying about one of them; prevote nil
+        (state.go defaultDoPrevote timestamp equality check)."""
+        cs = validator
+        now = time.time_ns()
+        _stage_proposal(cs, ts_ns=now, recv_ns=now)
+        # desync header time from proposal timestamp
+        cs.rs.proposal_block.header.time = Timestamp.from_unix_ns(now + NS)
+        cs.rs.proposal_block._hash = None
+        assert _prevote_cast(cs) == b""
+
+    def test_locked_validator_ignores_timeliness(self, validator):
+        """PBTS only gates FRESH proposals (pol_round == -1, nothing
+        locked): a validator already locked on the block re-prevotes it
+        even if the receive time looks stale (state.go:1512-1560)."""
+        cs = validator
+        sp = cs.state.consensus_params.synchrony
+        now = time.time_ns()
+        behind = int((sp.precision + sp.message_delay) * NS) + 500_000_000
+        block = _stage_proposal(cs, ts_ns=now - behind, recv_ns=now)
+        cs.rs.locked_round = 0
+        cs.rs.locked_block = block
+        cs.rs.locked_block_parts = cs.rs.proposal_block_parts
+        cs.rs.round = 1
+        cs.rs.proposal.round = 1
+        assert _prevote_cast(cs) == block.hash()
