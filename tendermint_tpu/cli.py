@@ -379,6 +379,100 @@ def cmd_replay(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """commands/light.go: run a light-client RPC proxy verified against a
+    primary full node with optional witnesses."""
+    from tendermint_tpu.light.client import LightClient, TrustOptions
+    from tendermint_tpu.light.provider import HTTPProvider
+    from tendermint_tpu.light.proxy import LightProxy
+
+    witnesses = [
+        HTTPProvider(args.chain_id, w) for w in (args.witness or [])
+    ]
+    client = LightClient(
+        chain_id=args.chain_id,
+        trust_options=TrustOptions(
+            period=args.trust_period,
+            height=args.trust_height,
+            hash=bytes.fromhex(args.trust_hash),
+        ),
+        primary=HTTPProvider(args.chain_id, args.primary),
+        witnesses=witnesses,
+        sequential=args.sequential,
+    )
+    proxy = LightProxy(client, args.primary, laddr=args.laddr)
+    stop = []
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    proxy.start()
+    print(f"light proxy for {args.chain_id} on {proxy.url}", flush=True)
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        proxy.stop()
+    return 0
+
+
+def cmd_debug_dump(args) -> int:
+    """commands/debug/dump.go: collect a diagnostic bundle from a RUNNING
+    node — status, consensus dump, net info, metrics — plus the home's
+    config and WAL files, into one tar.gz."""
+    import io
+    import json as jsonlib
+    import tarfile
+    import urllib.request
+
+    from tendermint_tpu.rpc.client import HTTPClient
+
+    client = HTTPClient(args.rpc)
+    bundle: Dict[str, bytes] = {}
+    for method in (
+        "status",
+        "dump_consensus_state",
+        "consensus_state",
+        "net_info",
+        "num_unconfirmed_txs",
+    ):
+        try:
+            doc = client.call(method)
+            bundle[f"{method}.json"] = jsonlib.dumps(doc, indent=2).encode()
+        except Exception as e:
+            bundle[f"{method}.err"] = str(e).encode()
+    try:
+        with urllib.request.urlopen(
+            f"{args.rpc.rstrip('/')}/metrics", timeout=5
+        ) as resp:
+            bundle["metrics.prom"] = resp.read()
+    except Exception as e:
+        bundle["metrics.err"] = str(e).encode()
+
+    home_files = []
+    if args.home and os.path.isdir(args.home):
+        cfg = Config(home=args.home)
+        for path in [cfg.config_file(), cfg.genesis_file()]:
+            if os.path.exists(path):
+                home_files.append(path)
+        wal_base = os.path.join(args.home, "cs.wal")
+        wal_dir = os.path.dirname(wal_base)
+        if os.path.isdir(wal_dir):
+            for name in sorted(os.listdir(wal_dir)):
+                if name.startswith("cs.wal"):
+                    home_files.append(os.path.join(wal_dir, name))
+
+    out_path = args.output
+    with tarfile.open(out_path, "w:gz") as tar:
+        for name, data in sorted(bundle.items()):
+            info = tarfile.TarInfo(f"dump/{name}")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+        for path in home_files:
+            tar.add(path, arcname=f"dump/home/{os.path.basename(path)}")
+    print(f"wrote debug dump to {out_path} ({len(bundle)} rpc docs, "
+          f"{len(home_files)} home files)")
+    return 0
+
+
 # --- entry ------------------------------------------------------------------
 
 
@@ -431,6 +525,26 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("replay", help="replay stored blocks into the app")
     p.set_defaults(fn=cmd_replay)
+
+    p = sub.add_parser("light", help="run a light-client RPC proxy")
+    p.add_argument("primary", help="primary full node RPC url")
+    p.add_argument("--chain-id", required=True)
+    p.add_argument("--trust-height", type=int, required=True)
+    p.add_argument("--trust-hash", required=True, help="hex header hash")
+    p.add_argument("--trust-period", type=float, default=14 * 86400.0)
+    p.add_argument("--witness", action="append", default=[])
+    p.add_argument("--laddr", default="127.0.0.1:0")
+    p.add_argument("--sequential", action="store_true")
+    p.set_defaults(fn=cmd_light)
+
+    p = sub.add_parser(
+        "debug", help="collect diagnostics from a running node"
+    )
+    dsub = p.add_subparsers(dest="debug_cmd", required=True)
+    d = dsub.add_parser("dump", help="status+consensus+metrics+WAL tarball")
+    d.add_argument("--rpc", default="http://127.0.0.1:26657")
+    d.add_argument("--output", "-o", default="tm-debug-dump.tgz")
+    d.set_defaults(fn=cmd_debug_dump)
 
     return ap
 

@@ -123,6 +123,15 @@ class HTTPProvider(Provider):
         # derived lazily from the reported priorities (get_proposer).
         vset = ValidatorSet()
         vset.validators = vals
+        # Derive the proposer from the REPORTED priorities now:
+        # validate_basic (LightClient init) requires a non-nil proposer
+        # and must not trip on a lazily-populated set. A defective node
+        # (empty valset) must surface as a ProviderError so the caller
+        # drops the WITNESS, not the whole verification.
+        try:
+            vset.get_proposer()
+        except ValueError as e:
+            raise ProviderError(f"bad validator set from node: {e}")
         return LightBlock(
             signed_header=SignedHeader(
                 header=enc.header_from_json(c["signed_header"]["header"]),

@@ -206,13 +206,19 @@ class _DeadlineStream(_SocketStream):
     """Stream with an ABSOLUTE deadline: every operation shrinks the
     socket timeout to the remaining budget, so a peer trickling one byte
     per timeout window cannot hold the handshake (and its per-IP slot)
-    open indefinitely (transport_mconn.go SetDeadline semantics)."""
+    open indefinitely (transport_mconn.go SetDeadline semantics).
+    ``disarm()`` turns it into a plain stream once the handshake is done."""
 
     def __init__(self, sock: socket.socket, deadline: float):
         super().__init__(sock)
-        self._deadline = deadline
+        self._deadline: Optional[float] = deadline
+
+    def disarm(self) -> None:
+        self._deadline = None
 
     def _arm(self) -> None:
+        if self._deadline is None:
+            return
         remaining = self._deadline - time.monotonic()
         if remaining <= 0:
             raise socket.timeout("handshake deadline exceeded")
@@ -273,11 +279,12 @@ class _TCPConn(Connection):
         self.remote_node_id = None  # known after handshake()
 
     def handshake(self, local_info: NodeInfo) -> NodeInfo:
-        deadline = time.monotonic() + self.HANDSHAKE_TIMEOUT
+        deadline_stream = _DeadlineStream(
+            self._sock, time.monotonic() + self.HANDSHAKE_TIMEOUT
+        )
         try:
             self._secret = SecretConnection(
-                _DeadlineStream(self._sock, deadline),
-                self._node_key.priv_key,
+                deadline_stream, self._node_key.priv_key
             )
             self.remote_node_id = node_id_from_pubkey(
                 self._secret.remote_pubkey
@@ -287,8 +294,8 @@ class _TCPConn(Connection):
             info = NodeInfo.from_json_bytes(self._secret.recv_msg())
         finally:
             self._sock.settimeout(None)
-        # handshake done: swap in the undeadlined stream for steady-state
-        self._secret._stream = _SocketStream(self._sock)
+        # handshake done: the deadline no longer applies to steady state
+        deadline_stream.disarm()
         # The authenticated transport key must match the claimed node id
         # (transport_mconn.go handshake validation).
         if info.node_id != self.remote_node_id:

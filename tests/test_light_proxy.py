@@ -1,0 +1,149 @@
+"""Light-client RPC proxy + debug dump tests (light/proxy,
+cmd/tendermint/commands/debug analogs)."""
+
+import json
+import os
+import tarfile
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.abci.kvstore import KVStoreApplication
+from tendermint_tpu.light.client import LightClient, TrustOptions
+from tendermint_tpu.light.provider import HTTPProvider
+from tendermint_tpu.light.proxy import LightProxy
+from tendermint_tpu.node.node import Node, NodeConfig
+from tendermint_tpu.privval.file_pv import FilePV
+from tests.test_node import CHAIN, fast_genesis, wait_for
+
+
+@pytest.fixture(scope="module")
+def full_node(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("lightproxy")
+    pv = FilePV.generate(str(tmp / "pk.json"), str(tmp / "ps.json"))
+    node = Node(
+        NodeConfig(
+            chain_id=CHAIN,
+            blocksync=False,
+            wal_enabled=False,
+            rpc_laddr="127.0.0.1:0",
+        ),
+        fast_genesis([pv]),
+        LocalClient(KVStoreApplication()),
+        priv_validator=pv,
+    )
+    node.start()
+    assert wait_for(lambda: node.height >= 4, timeout=60)
+    yield node
+    node.stop()
+
+
+def _trust_anchor(node, height=2):
+    meta = node.block_store.load_block_meta(height)
+    return TrustOptions(
+        period=86400.0, height=height, hash=meta.block_id.hash
+    )
+
+
+@pytest.fixture(scope="module")
+def proxy(full_node):
+    url = full_node.rpc_server.url
+    client = LightClient(
+        chain_id=CHAIN,
+        trust_options=_trust_anchor(full_node),
+        primary=HTTPProvider(CHAIN, url),
+        witnesses=[HTTPProvider(CHAIN, url)],
+    )
+    p = LightProxy(client, url)
+    p.start()
+    yield p
+    p.stop()
+
+
+def _get(url, path):
+    with urllib.request.urlopen(f"{url}/{path}", timeout=10) as resp:
+        doc = json.load(resp)
+    if "error" in doc:
+        raise AssertionError(doc["error"])
+    return doc["result"]
+
+
+class TestLightProxy:
+    def test_status(self, proxy):
+        out = _get(proxy.url, "status")
+        lc = out["light_client"]
+        assert lc["chain_id"] == CHAIN
+        assert int(lc["trusted_height"]) >= 2
+
+    def test_verified_header_and_commit(self, full_node, proxy):
+        h = full_node.height - 1
+        header = _get(proxy.url, f"header?height={h}")["header"]
+        assert int(header["height"]) == h
+        commit = _get(proxy.url, f"commit?height={h}")
+        assert commit["canonical"] is True
+        assert int(commit["signed_header"]["commit"]["height"]) == h
+        # the proxy's header matches the full node's block hash
+        meta = full_node.block_store.load_block_meta(h)
+        sh = commit["signed_header"]
+        assert (
+            sh["commit"]["block_id"]["hash"].lower().replace("0x", "")
+            == meta.block_id.hash.hex()
+        )
+
+    def test_verified_validators(self, full_node, proxy):
+        h = full_node.height - 1
+        out = _get(proxy.url, f"validators?height={h}")
+        assert out["count"] == "1"
+
+    def test_tampered_trust_anchor_fails(self, full_node):
+        bad = TrustOptions(period=86400.0, height=2, hash=b"\x11" * 32)
+        with pytest.raises(Exception):
+            LightClient(
+                chain_id=CHAIN,
+                trust_options=bad,
+                primary=HTTPProvider(CHAIN, full_node.rpc_server.url),
+                witnesses=[],
+            )
+
+    def test_abci_query_pinned_to_verified_height(self, full_node, proxy):
+        full_node.submit_tx(b"lightq=1")
+        assert wait_for(
+            lambda: full_node.app.query(
+                __import__(
+                    "tendermint_tpu.abci.types", fromlist=["RequestQuery"]
+                ).RequestQuery(data=b"lightq")
+            ).value
+            == b"1",
+            timeout=30,
+        )
+        out = _get(proxy.url, 'abci_query?data="0x6c6967687471"')
+        resp = out["response"]
+        assert int(resp["verified_height"]) >= 2
+
+
+class TestDebugDump:
+    def test_dump_bundle(self, full_node, tmp_path):
+        from tendermint_tpu.cli import main as cli_main
+
+        out = str(tmp_path / "dump.tgz")
+        rc = cli_main(
+            [
+                "debug",
+                "dump",
+                "--rpc",
+                full_node.rpc_server.url,
+                "-o",
+                out,
+            ]
+        )
+        assert rc == 0
+        with tarfile.open(out) as tar:
+            names = tar.getnames()
+            assert "dump/status.json" in names
+            assert "dump/dump_consensus_state.json" in names
+            assert "dump/metrics.prom" in names
+            status = json.load(tar.extractfile("dump/status.json"))
+            assert int(status["sync_info"]["latest_block_height"]) >= 2
+            metrics = tar.extractfile("dump/metrics.prom").read().decode()
+            assert "tendermint_consensus_height" in metrics

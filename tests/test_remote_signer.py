@@ -164,7 +164,9 @@ class TestInProcess:
         )
         legit.start()
         try:
-            ep.wait_for_connection(10)
+            # generous: under full-suite CPU contention each rejected
+            # stranger dial costs a SecretConnection handshake first
+            ep.wait_for_connection(30)
             SignerClient(ep, CHAIN).ping()
         finally:
             legit.stop()
