@@ -71,7 +71,7 @@ class RPCServer:
                 body = self.rfile.read(length) if length else b""
                 try:
                     req = json.loads(body or b"{}")
-                except json.JSONDecodeError:
+                except (json.JSONDecodeError, UnicodeDecodeError):
                     self._reply(None, error=(PARSE_ERROR, "parse error", ""))
                     return
                 if isinstance(req, list):
@@ -186,6 +186,18 @@ class RPCServer:
     # -- dispatch -------------------------------------------------------------
 
     def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        if not isinstance(req, dict):
+            # JSON-RPC: a request must be an object; a valid-JSON scalar
+            # or string body is an invalid request, not a server error
+            return {
+                "jsonrpc": "2.0",
+                "id": None,
+                "error": {
+                    "code": INVALID_REQUEST,
+                    "message": "request must be a JSON object",
+                    "data": "",
+                },
+            }
         id_ = req.get("id")
         resp: Dict[str, Any] = {"jsonrpc": "2.0", "id": id_}
         method = req.get("method")
