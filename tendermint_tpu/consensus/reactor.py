@@ -342,19 +342,32 @@ class ConsensusReactor(Broadcaster):
         if p_height < store.base():
             return False
         meta = store.load_block_meta(p_height)
-        commit = store.load_block_commit(p_height)
-        if commit is None:
-            # The canonical commit for p_height is only stored once block
-            # p_height+1 lands; until then the seen commit covers it
-            # (reference serves rs.LastCommit to height-1 peers,
-            # reactor.go:736).
-            seen = store.load_seen_commit()
-            if seen is not None and seen.height == p_height:
-                commit = seen
+        # With vote extensions enabled the peer REQUIRES extensions on
+        # every non-nil precommit, so when an extended commit is stored
+        # it is the ONLY source served — its round/absence bookkeeping
+        # can legitimately differ from the canonical commit (written by
+        # the h+1 proposer), and mixing indices between the two would
+        # serve wrong-round or unsigned votes that the peer rejects
+        # while we mark them sent.
+        ext_commit = store.load_block_extended_commit(p_height)
+        commit = None
+        if ext_commit is None:
+            commit = store.load_block_commit(p_height)
+            if commit is None:
+                # The canonical commit for p_height is only stored once
+                # block p_height+1 lands; until then the seen commit
+                # covers it (reference serves rs.LastCommit to height-1
+                # peers, reactor.go:736).
+                seen = store.load_seen_commit()
+                if seen is not None and seen.height == p_height:
+                    commit = seen
         if meta is None:
             return False
         n_parts = meta.block_id.part_set_header.total
-        n_sigs = commit.size() if commit is not None else 0
+        if ext_commit is not None:
+            n_sigs = ext_commit.size()
+        else:
+            n_sigs = commit.size() if commit is not None else 0
         ps.ensure_catchup(p_height, n_parts, n_sigs)
         sent = False
         # One part per iteration, preferring whatever the peer lacks.
@@ -376,19 +389,26 @@ class ConsensusReactor(Broadcaster):
             sent = True
             break
         # Commit precommits let the lagging peer finish its round
-        # (reactor.go:736 LastCommit case).
-        if commit is not None:
+        # (reactor.go:736 LastCommit case). One source drives the whole
+        # loop: the extended commit when stored, the canonical/seen
+        # commit otherwise.
+        if ext_commit is not None or commit is not None:
             budget = VOTES_PER_ITER
             for i in range(n_sigs):
                 if budget == 0:
                     break
                 if ps.catchup_commit.get_index(i):
                     continue
-                sig = commit.signatures[i]
-                if not sig.signature:
-                    ps.catchup_commit.set_index(i, True)
-                    continue
-                vote = commit.get_vote(i)
+                if ext_commit is not None:
+                    if not ext_commit.extended_signatures[i].commit_sig.signature:
+                        ps.catchup_commit.set_index(i, True)
+                        continue
+                    vote = ext_commit.get_extended_vote(i)
+                else:
+                    if not commit.signatures[i].signature:
+                        ps.catchup_commit.set_index(i, True)
+                        continue
+                    vote = commit.get_vote(i)
                 self.vote_ch.send(
                     Envelope(VOTE_CHANNEL, encode_vote(vote), to_peer=ps.peer_id)
                 )

@@ -75,6 +75,14 @@ class RPCServer:
                     self._reply(None, error=(PARSE_ERROR, "parse error", ""))
                     return
                 if isinstance(req, list):
+                    if not req:
+                        # JSON-RPC 2.0: empty batch is a single invalid
+                        # request error, not an empty array
+                        self._reply(
+                            None,
+                            error=(INVALID_REQUEST, "empty batch", ""),
+                        )
+                        return
                     out = [server._dispatch(r) for r in req]
                     self._send(200, json.dumps(out).encode())
                     return

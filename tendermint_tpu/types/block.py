@@ -486,6 +486,27 @@ class ExtendedCommit:
             extended_signatures=[ExtendedCommitSig(s) for s in commit.signatures],
         )
 
+    def get_extended_vote(self, val_idx: int) -> "Vote":
+        """The precommit this entry came from, WITH its extension —
+        catch-up gossip must serve these when vote extensions are
+        enabled, or a lagging peer (which requires extensions on every
+        non-nil precommit) rejects the reconstruction and deadlocks.
+        Built directly from the entry (no O(n) Commit rebuild)."""
+        e = self.extended_signatures[val_idx]
+        cs = e.commit_sig
+        return Vote(
+            type=SIGNED_MSG_TYPE_PRECOMMIT,
+            height=self.height,
+            round=self.round,
+            block_id=cs.block_id(self.block_id),
+            timestamp=cs.timestamp,
+            validator_address=cs.validator_address,
+            validator_index=val_idx,
+            signature=cs.signature,
+            extension=e.extension,
+            extension_signature=e.extension_signature,
+        )
+
     def ensure_extensions(self) -> None:
         for e in self.extended_signatures:
             e.ensure_extension()

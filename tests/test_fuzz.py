@@ -212,6 +212,7 @@ class TestRPCServerFuzz:
         assert post(b"42")["error"]["code"] == -32600
         assert post(b'"a string"')["error"]["code"] == -32600
         assert post(b"null")["error"]["code"] == -32600
+        assert post(b"[]")["error"]["code"] == -32600  # empty batch
         batch = post(b'[7, {"jsonrpc":"2.0","id":1,"method":"echo","params":{}}]')
         assert batch[0]["error"]["code"] == -32600
         assert batch[1]["result"] == {}
