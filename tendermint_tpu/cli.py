@@ -473,6 +473,94 @@ def cmd_debug_dump(args) -> int:
     return 0
 
 
+def cmd_wal2json(args) -> int:
+    """scripts/wal2json analog: decode a consensus WAL (all rotated
+    chunks) to one JSON document per record on stdout."""
+    import dataclasses
+    import json as jsonlib
+
+    from tendermint_tpu.consensus import wal as walmod
+
+    if not os.path.exists(args.wal):
+        # an empty group and a typo'd path look identical to the reader;
+        # distinguish them here (main() maps this to a clean error)
+        raise FileNotFoundError(args.wal)
+    w = walmod.WAL(args.wal)
+    for offset, msg in w.iter_messages():
+        doc: Dict[str, object] = {"offset": offset, "type": type(msg).__name__}
+        if dataclasses.is_dataclass(msg):
+            for f in dataclasses.fields(msg):
+                v = getattr(msg, f.name)
+                if isinstance(v, bytes):
+                    v = v.hex()
+                elif dataclasses.is_dataclass(v) or hasattr(v, "__dict__"):
+                    v = repr(v)
+                doc[f.name] = v
+        else:
+            doc["repr"] = repr(msg)
+        print(jsonlib.dumps(doc, default=repr))
+    return 0
+
+
+def cmd_abci(args) -> int:
+    """abci/cmd/abci-cli analog: drive an ABCI socket app manually."""
+    import base64
+    import json as jsonlib
+
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.socket_client import SocketClient
+
+    host, _, port = args.addr.replace("tcp://", "").rpartition(":")
+    client = SocketClient(host or "127.0.0.1", int(port))
+    client.start()
+    try:
+        if args.abci_cmd == "info":
+            r = client.info(abci.RequestInfo())
+            print(
+                jsonlib.dumps(
+                    {
+                        "data": r.data,
+                        "version": r.version,
+                        "app_version": r.app_version,
+                        "last_block_height": r.last_block_height,
+                        "last_block_app_hash": r.last_block_app_hash.hex(),
+                    }
+                )
+            )
+        elif args.abci_cmd == "echo":
+            r = client.echo(args.message)
+            print(r)
+        elif args.abci_cmd == "query":
+            r = client.query(
+                abci.RequestQuery(
+                    data=args.data.encode(), path=args.path or ""
+                )
+            )
+            print(
+                jsonlib.dumps(
+                    {
+                        "code": r.code,
+                        "key": base64.b64encode(r.key).decode(),
+                        "value": base64.b64encode(r.value).decode(),
+                        "log": r.log,
+                        "height": r.height,
+                    }
+                )
+            )
+        elif args.abci_cmd == "check-tx":
+            r = client.check_tx(
+                abci.RequestCheckTx(
+                    tx=args.tx.encode(), type=abci.CHECK_TX_TYPE_NEW
+                )
+            )
+            print(
+                jsonlib.dumps({"code": r.code, "codespace": r.codespace})
+            )
+    finally:
+        client.stop()
+    return 0
+
+
 # --- entry ------------------------------------------------------------------
 
 
@@ -545,6 +633,29 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--rpc", default="http://127.0.0.1:26657")
     d.add_argument("--output", "-o", default="tm-debug-dump.tgz")
     d.set_defaults(fn=cmd_debug_dump)
+
+    p = sub.add_parser("wal2json", help="decode a consensus WAL to JSON")
+    p.add_argument("wal", help="path to the WAL head file")
+    p.set_defaults(fn=cmd_wal2json)
+
+    p = sub.add_parser("abci", help="drive an ABCI socket app manually")
+    asub = p.add_subparsers(dest="abci_cmd", required=True)
+    a = asub.add_parser("info")
+    a.add_argument("--addr", default="tcp://127.0.0.1:26658")
+    a.set_defaults(fn=cmd_abci)
+    a = asub.add_parser("echo")
+    a.add_argument("message")
+    a.add_argument("--addr", default="tcp://127.0.0.1:26658")
+    a.set_defaults(fn=cmd_abci)
+    a = asub.add_parser("query")
+    a.add_argument("data")
+    a.add_argument("--path", default="")
+    a.add_argument("--addr", default="tcp://127.0.0.1:26658")
+    a.set_defaults(fn=cmd_abci)
+    a = asub.add_parser("check-tx")
+    a.add_argument("tx")
+    a.add_argument("--addr", default="tcp://127.0.0.1:26658")
+    a.set_defaults(fn=cmd_abci)
 
     return ap
 

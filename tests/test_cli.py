@@ -266,3 +266,64 @@ class TestRollback:
         assert _run(["--home", home, "rollback"]) == 0
         # replay pushes the stored blocks back into a fresh app
         assert _run(["--home", home, "replay"]) == 0
+
+
+class TestDebugTools:
+    def test_wal2json(self, tmp_path, capsys):
+        from tendermint_tpu.consensus.wal import WAL, EndHeightMessage, TimeoutInfo
+
+        path = str(tmp_path / "cs.wal")
+        w = WAL(path)
+        w.start()
+        w.write(TimeoutInfo(0.5, 3, 1, 2))
+        w.write_sync(EndHeightMessage(3))
+        w.stop()
+        assert _run(["wal2json", path]) == 0
+        lines = [json.loads(s) for s in capsys.readouterr().out.splitlines()]
+        assert [d["type"] for d in lines] == ["TimeoutInfo", "EndHeightMessage"]
+        assert lines[0]["height"] == 3 and lines[0]["round"] == 1
+        assert lines[1]["height"] == 3
+
+    def test_abci_cli_against_socket_app(self, capsys):
+        import subprocess
+        import socket as socketlib
+        import time as timelib
+
+        s = socketlib.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "tendermint_tpu.abci.socket_server",
+                "--addr",
+                f"127.0.0.1:{port}",
+            ],
+            cwd=REPO,
+        )
+        try:
+            deadline = timelib.monotonic() + 15
+            while timelib.monotonic() < deadline:
+                try:
+                    probe = socketlib.create_connection(("127.0.0.1", port), 1)
+                    probe.close()
+                    break
+                except OSError:
+                    timelib.sleep(0.2)
+            else:
+                pytest.fail("socket app never came up")
+            addr = f"tcp://127.0.0.1:{port}"
+            assert _run(["abci", "echo", "ping!", "--addr", addr]) == 0
+            assert capsys.readouterr().out.strip() == "ping!"
+            assert _run(["abci", "info", "--addr", addr]) == 0
+            doc = json.loads(capsys.readouterr().out)
+            assert doc["last_block_height"] == 0
+            assert _run(["abci", "check-tx", "a=b", "--addr", addr]) == 0
+            assert json.loads(capsys.readouterr().out)["code"] == 0
+            assert _run(["abci", "query", "a", "--addr", addr]) == 0
+            assert "log" in json.loads(capsys.readouterr().out)
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
