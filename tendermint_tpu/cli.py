@@ -669,15 +669,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
     except BrokenPipeError:
         return 0  # stdout consumer (e.g. `head`) closed early
-    except (ValueError, OSError) as e:
+    except (ValueError, OSError, RuntimeError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
     except Exception as e:
-        # operator-facing failures from deeper layers (e.g. the remote
-        # signer never dialing in) should read as errors, not tracebacks
+        # operator-facing failures from deeper layers (a remote signer
+        # never dialing in, a corrupt WAL under wal2json) should read as
+        # errors, not tracebacks
+        from tendermint_tpu.consensus.wal import WALCorruptionError
         from tendermint_tpu.privval.remote import RemoteSignerError
 
-        if isinstance(e, RemoteSignerError):
+        if isinstance(e, (RemoteSignerError, WALCorruptionError)):
             print(f"error: {e}", file=sys.stderr)
             return 1
         raise

@@ -103,3 +103,30 @@ def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
 
         return Sr25519BatchVerifier()
     raise ValueError(f"key type {pub_key.type} does not support batching")
+
+
+import threading as _threading
+
+_shared_scheduler = None
+_shared_scheduler_lock = _threading.Lock()
+
+
+def get_shared_scheduler():
+    """Process-wide accumulate-with-deadline scheduler fronting the
+    device batch verifier (crypto/scheduler.py) — the seam for callers
+    that ingest signatures from many concurrent sources (per-peer vote
+    floods, RPC storms) and want device batching without paying a
+    device launch per signature. Lazily started on first use."""
+    global _shared_scheduler
+    with _shared_scheduler_lock:
+        if _shared_scheduler is None:
+            from tendermint_tpu.crypto.scheduler import VerifyScheduler
+
+            def _verify(pks, msgs, sigs):
+                from tendermint_tpu.ops import verify_batch
+
+                return verify_batch(pks, msgs, sigs)
+
+            _shared_scheduler = VerifyScheduler(_verify)
+            _shared_scheduler.start()
+        return _shared_scheduler

@@ -1,0 +1,164 @@
+"""Accumulate-with-deadline batch verification scheduler.
+
+The latency/throughput duality (SURVEY §7 "Hard parts"): consensus votes
+arrive one at a time and need ~100µs-class answers, while the device
+verifier only pays off in batches. This scheduler is the seam between
+them: concurrent callers submit single (pubkey, msg, sig) verifies and
+block on a future; an accumulator thread flushes the pending set to ONE
+batch verification when either
+
+- the batch reaches ``max_batch`` entries (throughput bound), or
+- the OLDEST pending entry has waited ``max_delay`` (latency bound) —
+  the deadline is per-entry, so a lone vote is answered within
+  ``max_delay`` even when nothing else arrives.
+
+Per-entry verdicts come from the batch verifier's attribution (the
+reference's BatchVerifier.Verify bool slice, crypto/crypto.go:58-76), so
+one bad signature fails only its own future.
+
+Wiring: callers that ingest signatures from many concurrent sources
+(per-peer vote floods, RPC broadcast storms) submit here instead of
+calling ``pub_key.verify_signature`` inline; the single-threaded
+consensus loop keeps its inline host verify, which is already
+latency-optimal for one caller.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+DEFAULT_MAX_BATCH = 256
+DEFAULT_MAX_DELAY = 0.002  # 2ms: well under a vote round-trip
+
+
+@dataclass
+class _Pending:
+    pubkey: bytes
+    msg: bytes
+    sig: bytes
+    submitted: float
+    done: threading.Event = field(default_factory=threading.Event)
+    ok: bool = False
+
+
+class VerifyScheduler:
+    """Batches concurrent single-signature verifies onto one verifier call.
+
+    ``verify_fn(pks, msgs, sigs) -> List[bool]`` is the flush target —
+    ``ops.verify_batch`` on a device backend, or any host batch verifier.
+    """
+
+    def __init__(
+        self,
+        verify_fn: Callable[
+            [Sequence[bytes], Sequence[bytes], Sequence[bytes]], List[bool]
+        ],
+        max_batch: int = DEFAULT_MAX_BATCH,
+        max_delay: float = DEFAULT_MAX_DELAY,
+    ):
+        self._verify_fn = verify_fn
+        self.max_batch = max_batch
+        self.max_delay = max_delay
+        self._pending: List[_Pending] = []
+        self._mtx = threading.Lock()
+        self._wake = threading.Condition(self._mtx)
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        # observability
+        self.flushes = 0
+        self.entries_verified = 0
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        with self._mtx:
+            if self._thread is not None:
+                return
+            self._stop = False
+            # assign under the lock: a concurrent start() must see it
+            self._thread = threading.Thread(
+                target=self._run, name="verify-scheduler", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._wake:
+            self._stop = True
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        # fail any stragglers closed rather than hanging their callers
+        with self._mtx:
+            leftovers, self._pending = self._pending, []
+        for p in leftovers:
+            p.ok = False
+            p.done.set()
+
+    # --- submission ----------------------------------------------------------
+
+    def submit(self, pubkey: bytes, msg: bytes, sig: bytes) -> _Pending:
+        """Enqueue one signature; returns a handle for ``wait``. Callers
+        with several signatures submit all first so one flush covers
+        them, instead of paying the deadline once per signature."""
+        entry = _Pending(pubkey, msg, sig, time.monotonic())
+        with self._wake:
+            if self._stop or self._thread is None:
+                raise RuntimeError("scheduler not running")
+            self._pending.append(entry)
+            self._wake.notify_all()
+        return entry
+
+    def wait(self, entry: _Pending, timeout: float = 10.0) -> bool:
+        """Block until the entry's batch flushed; False on timeout (fail
+        closed: an unverified signature is an invalid signature)."""
+        if not entry.done.wait(timeout=timeout):
+            return False
+        return entry.ok
+
+    def verify(
+        self, pubkey: bytes, msg: bytes, sig: bytes, timeout: float = 10.0
+    ) -> bool:
+        """Submit one signature and block until its batch flushes."""
+        return self.wait(self.submit(pubkey, msg, sig), timeout=timeout)
+
+    # --- accumulator ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._wake:
+                while not self._stop:
+                    if len(self._pending) >= self.max_batch:
+                        break
+                    if self._pending:
+                        oldest = self._pending[0].submitted
+                        wait = self.max_delay - (time.monotonic() - oldest)
+                        if wait <= 0:
+                            break
+                        self._wake.wait(timeout=wait)
+                    else:
+                        self._wake.wait(timeout=0.1)
+                if self._stop:
+                    return
+                batch, self._pending = (
+                    self._pending[: self.max_batch],
+                    self._pending[self.max_batch :],
+                )
+            if not batch:
+                continue
+            try:
+                oks = self._verify_fn(
+                    [p.pubkey for p in batch],
+                    [p.msg for p in batch],
+                    [p.sig for p in batch],
+                )
+            except Exception:
+                oks = [False] * len(batch)  # fail closed, never hang callers
+            self.flushes += 1
+            self.entries_verified += len(batch)
+            for p, ok in zip(batch, oks):
+                p.ok = bool(ok)
+                p.done.set()

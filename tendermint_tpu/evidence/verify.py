@@ -48,14 +48,43 @@ def verify_duplicate_vote(
         )
     if pub_key.address() != e.vote_a.validator_address:
         raise InvalidEvidenceError("address doesn't match pubkey")
-    if not pub_key.verify_signature(
-        e.vote_a.sign_bytes(chain_id), e.vote_a.signature
-    ):
+    # Evidence arrives on concurrent paths (RPC handler threads,
+    # per-peer reactor delivery): ed25519 verifies go through the shared
+    # accumulate-with-deadline scheduler so simultaneous submissions
+    # share one device batch (crypto/scheduler.py); other key types
+    # verify inline.
+    ok_a, ok_b = _verify_pair(
+        pub_key,
+        e.vote_a.sign_bytes(chain_id),
+        e.vote_a.signature,
+        e.vote_b.sign_bytes(chain_id),
+        e.vote_b.signature,
+    )
+    if not ok_a:
         raise InvalidEvidenceError("verifying VoteA: invalid signature")
-    if not pub_key.verify_signature(
-        e.vote_b.sign_bytes(chain_id), e.vote_b.signature
-    ):
+    if not ok_b:
         raise InvalidEvidenceError("verifying VoteB: invalid signature")
+
+
+def _verify_pair(pub_key, msg_a, sig_a, msg_b, sig_b):
+    from tendermint_tpu.crypto.keys import ED25519_KEY_TYPE
+
+    if pub_key.type == ED25519_KEY_TYPE:
+        try:
+            from tendermint_tpu.crypto.batch import get_shared_scheduler
+
+            sched = get_shared_scheduler()
+            pk = pub_key.bytes()
+            # submit both, then wait: one flush covers the pair
+            ha = sched.submit(pk, msg_a, sig_a)
+            hb = sched.submit(pk, msg_b, sig_b)
+            return sched.wait(ha), sched.wait(hb)
+        except RuntimeError:
+            pass  # scheduler stopped: fall through to inline verify
+    return (
+        pub_key.verify_signature(msg_a, sig_a),
+        pub_key.verify_signature(msg_b, sig_b),
+    )
 
 
 def verify_light_client_attack(

@@ -1,0 +1,155 @@
+"""Accumulate-with-deadline verify scheduler tests (SURVEY §7 latency
+duality seam)."""
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.crypto.ed25519_ref import verify_zip215
+from tendermint_tpu.crypto.scheduler import VerifyScheduler
+
+
+def host_verify(pks, msgs, sigs):
+    return [verify_zip215(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+
+
+@pytest.fixture()
+def sched():
+    s = VerifyScheduler(host_verify, max_batch=32, max_delay=0.05)
+    s.start()
+    yield s
+    s.stop()
+
+
+def _signed(i: int):
+    priv = Ed25519PrivKey.from_seed(bytes([i]) * 32)
+    msg = b"sched-msg-%d" % i
+    return priv.pub_key().bytes(), msg, priv.sign(msg)
+
+
+class TestDeadline:
+    def test_lone_entry_answers_within_deadline(self, sched):
+        pk, msg, sig = _signed(1)
+        t0 = time.monotonic()
+        assert sched.verify(pk, msg, sig)
+        elapsed = time.monotonic() - t0
+        # one flush, no batch partners: the deadline bounds the wait
+        assert elapsed < 1.0
+        assert sched.flushes == 1
+
+    def test_bad_signature_fails_only_itself(self, sched):
+        good = [_signed(i) for i in range(4)]
+        results = {}
+
+        def submit(idx, pk, msg, sig):
+            results[idx] = sched.verify(pk, msg, sig)
+
+        threads = []
+        for i, (pk, msg, sig) in enumerate(good):
+            bad_sig = bytes(64) if i == 2 else sig
+            t = threading.Thread(target=submit, args=(i, pk, msg, bad_sig))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=10)
+        assert results == {0: True, 1: True, 2: False, 3: True}
+
+
+class TestBatching:
+    def test_concurrent_callers_share_flushes(self):
+        calls = []
+
+        def counting_verify(pks, msgs, sigs):
+            calls.append(len(pks))
+            return host_verify(pks, msgs, sigs)
+
+        s = VerifyScheduler(counting_verify, max_batch=64, max_delay=0.2)
+        s.start()
+        try:
+            entries = [_signed(i % 8) for i in range(40)]
+            results = [None] * 40
+
+            def submit(i):
+                pk, msg, sig = entries[i]
+                results[i] = s.verify(pk, msg, sig)
+
+            threads = [
+                threading.Thread(target=submit, args=(i,)) for i in range(40)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=15)
+            assert all(results)
+            # 40 concurrent verifies amortized into far fewer flushes
+            assert len(calls) < 10, calls
+            assert sum(calls) == 40
+        finally:
+            s.stop()
+
+    def test_max_batch_flushes_without_deadline(self):
+        s = VerifyScheduler(host_verify, max_batch=4, max_delay=60.0)
+        s.start()
+        try:
+            entries = [_signed(i) for i in range(4)]
+            results = [None] * 4
+            threads = [
+                threading.Thread(
+                    target=lambda i=i: results.__setitem__(
+                        i, s.verify(*entries[i])
+                    )
+                )
+                for i in range(4)
+            ]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            # the batch-size trigger fired: nowhere near the 60s deadline
+            assert time.monotonic() - t0 < 10
+            assert all(results)
+        finally:
+            s.stop()
+
+
+class TestFailureModes:
+    def test_verifier_exception_fails_closed(self):
+        def broken(pks, msgs, sigs):
+            raise RuntimeError("device on fire")
+
+        s = VerifyScheduler(broken, max_batch=8, max_delay=0.01)
+        s.start()
+        try:
+            pk, msg, sig = _signed(1)
+            assert s.verify(pk, msg, sig) is False
+        finally:
+            s.stop()
+
+    def test_stop_fails_pending_closed(self):
+        started = threading.Event()
+
+        def slow(pks, msgs, sigs):
+            started.set()
+            time.sleep(0.5)
+            return [True] * len(pks)
+
+        s = VerifyScheduler(slow, max_batch=1, max_delay=0.01)
+        s.start()
+        pk, msg, sig = _signed(1)
+        out = {}
+        t = threading.Thread(target=lambda: out.setdefault("r", s.verify(pk, msg, sig)))
+        t.start()
+        started.wait(timeout=5)
+        s.stop()
+        t.join(timeout=5)
+        assert out["r"] in (True, False)  # resolved, never hung
+
+    def test_submit_after_stop_raises(self):
+        s = VerifyScheduler(host_verify)
+        s.start()
+        s.stop()
+        with pytest.raises(RuntimeError):
+            s.verify(b"\x00" * 32, b"m", b"\x00" * 64)
