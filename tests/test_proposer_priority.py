@@ -1,0 +1,137 @@
+"""Proposer-priority selection tests (types/validator_set.go:116-243).
+
+The SURVEY calls this out as consensus-critical integer math: proposer
+rotation must match the reference's weighted-round-robin exactly or
+validators disagree about whose proposal to accept. These tests pin the
+reference's published invariants (validator_set_test.go
+TestProposerSelection1-3, TestAveragingInIncrementProposerPriority):
+equal-power round-robin, power-proportional selection frequency,
+priority centering, the rescale window, and the new-validator penalty.
+"""
+
+from collections import Counter
+
+import pytest
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.types import Validator, ValidatorSet
+from tendermint_tpu.types.validator_set import PRIORITY_WINDOW_SIZE_FACTOR
+
+
+def _vals(powers):
+    out = []
+    for i, p in enumerate(powers):
+        pub = Ed25519PrivKey.from_seed(bytes([i + 1]) * 32).pub_key()
+        out.append(Validator(pub, p))
+    return out
+
+
+def _spin(vset, rounds):
+    """One proposer per consensus round (increment once per round)."""
+    seq = []
+    for _ in range(rounds):
+        seq.append(vset.get_proposer().address)
+        vset.increment_proposer_priority(1)
+    return seq
+
+
+class TestRoundRobin:
+    def test_equal_power_rotates_fairly(self):
+        vset = ValidatorSet(_vals([10, 10, 10, 10]))
+        seq = _spin(vset, 40)
+        counts = Counter(seq)
+        # perfect rotation: every validator proposes exactly 10 times
+        assert sorted(counts.values()) == [10, 10, 10, 10]
+        # and the rotation has period 4 (no validator twice in a window)
+        for i in range(0, 40, 4):
+            assert len(set(seq[i : i + 4])) == 4
+
+    def test_single_validator_always_proposes(self):
+        vset = ValidatorSet(_vals([5]))
+        seq = _spin(vset, 7)
+        assert len(set(seq)) == 1
+
+
+class TestWeightedSelection:
+    def test_frequency_proportional_to_power(self):
+        """TestProposerSelection3 semantics: over N rounds each validator
+        proposes power/total * N times (exactly, for the deterministic
+        weighted round-robin)."""
+        powers = [1, 2, 3]
+        vset = ValidatorSet(_vals(powers))
+        by_addr = {
+            v.address: v.voting_power for v in vset.validators
+        }
+        rounds = 6 * 100  # total power * 100
+        counts = Counter(_spin(vset, rounds))
+        for addr, n in counts.items():
+            expect = by_addr[addr] * 100
+            assert abs(n - expect) <= 1, (
+                f"power {by_addr[addr]}: proposed {n}, expected ~{expect}"
+            )
+
+    def test_dominant_validator_majority(self):
+        vset = ValidatorSet(_vals([100, 1, 1]))
+        counts = Counter(_spin(vset, 102))
+        assert max(counts.values()) == 100
+
+
+class TestPriorityInvariants:
+    def test_priorities_centered_after_increment(self):
+        """IncrementProposerPriority keeps the priority sum centered on
+        zero (validator_set.go shiftByAvgProposerPriority)."""
+        vset = ValidatorSet(_vals([3, 7, 11]))
+        n = len(vset.validators)
+        for _ in range(50):
+            vset.increment_proposer_priority(1)
+            total = sum(v.proposer_priority for v in vset.validators)
+            assert abs(total) < n, f"priorities drifted: sum={total}"
+
+    def test_rescale_window_bound(self):
+        """Priority spread stays within 2 * TotalVotingPower
+        (PriorityWindowSizeFactor, validator_set.go:30)."""
+        vset = ValidatorSet(_vals([1, 1000]))
+        cap = PRIORITY_WINDOW_SIZE_FACTOR * vset.total_voting_power()
+        for _ in range(100):
+            vset.increment_proposer_priority(1)
+            prios = [v.proposer_priority for v in vset.validators]
+            assert max(prios) - min(prios) <= cap
+
+    def test_increment_times_equals_repeated_single(self):
+        a = ValidatorSet(_vals([2, 5, 9]))
+        b = ValidatorSet(_vals([2, 5, 9]))
+        a.increment_proposer_priority(5)
+        for _ in range(5):
+            b.increment_proposer_priority(1)
+        assert [v.proposer_priority for v in a.validators] == [
+            v.proposer_priority for v in b.validators
+        ]
+        assert a.get_proposer().address == b.get_proposer().address
+
+
+class TestSetUpdates:
+    def test_new_validator_pays_entry_penalty(self):
+        """A joining validator starts at -1.125 * total power so it
+        cannot immediately propose (validator_set.go:447-470)."""
+        vset = ValidatorSet(_vals([10, 10]))
+        vset.increment_proposer_priority(3)
+        newcomer = _vals([1, 1, 10])[2]  # distinct key (seed 3)
+        vset.update_with_change_set([newcomer])
+        joined = next(
+            v
+            for v in vset.validators
+            if v.address == newcomer.address
+        )
+        assert joined.proposer_priority < 0
+        # the penalty must keep the joiner from winning the NEXT
+        # selection (post-update increment recomputes the proposer —
+        # asserting on the pre-update cache would be vacuous)
+        vset.increment_proposer_priority(1)
+        assert vset.get_proposer().address != joined.address
+
+    def test_deterministic_across_copies(self):
+        vset = ValidatorSet(_vals([4, 4, 4]))
+        clone = vset.copy()
+        s1 = _spin(vset, 12)
+        s2 = _spin(clone, 12)
+        assert s1 == s2
