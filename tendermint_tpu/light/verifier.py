@@ -105,7 +105,10 @@ def verify_non_adjacent(
     if untrusted_header.height == trusted_header.height + 1:
         raise InvalidHeaderError("headers must be non adjacent in height")
     validate_trust_level(trust_level)
-    if header_expired(untrusted_header, trusting_period, now):
+    # the TRUSTED header's age gates verification (verifier.go:47): an
+    # expired trust root must not anchor new updates, however fresh the
+    # untrusted header looks — that is the long-range-attack window
+    if header_expired(trusted_header, trusting_period, now):
         raise HeaderExpiredError("old header has expired")
     _verify_new_header_and_vals(
         untrusted_header, untrusted_vals, trusted_header, now, max_clock_drift
@@ -142,7 +145,8 @@ def verify_adjacent(
     _check_required_header_fields(trusted_header)
     if untrusted_header.height != trusted_header.height + 1:
         raise InvalidHeaderError("headers must be adjacent in height")
-    if header_expired(untrusted_header, trusting_period, now):
+    # trusted-header expiry, as above (verifier.go:116)
+    if header_expired(trusted_header, trusting_period, now):
         raise HeaderExpiredError("old header has expired")
     _verify_new_header_and_vals(
         untrusted_header, untrusted_vals, trusted_header, now, max_clock_drift

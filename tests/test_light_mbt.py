@@ -1,0 +1,238 @@
+"""Model-based light-client conformance tests.
+
+Drives the TLA+-model-generated traces the reference ships
+(light/mbt/json/*.json, vendored unchanged into tests/mbt_json/ as test
+VECTORS — they carry real ed25519 signatures over canonical sign bytes,
+so passing them proves byte-exact wire compatibility of header hashing,
+vote sign bytes, commit verification, and the skipping-verification
+trust logic all at once). The driver mirrors light/mbt/driver_test.go:
+for each input block, light.verify must yield the trace's verdict —
+SUCCESS, NOT_ENOUGH_TRUST (trust-level shortfall on a non-adjacent
+jump), or INVALID (bad header or expired trusted header) — and advance
+the trusted state only on success.
+"""
+
+import base64
+import glob
+import json
+import os
+
+import pytest
+
+from tendermint_tpu.light import verifier
+from tendermint_tpu.light.verifier import (
+    HeaderExpiredError,
+    InvalidHeaderError,
+    NewValSetCantBeTrustedError,
+)
+from tendermint_tpu.rpc.encoding import parse_rfc3339
+from tendermint_tpu.crypto.keys import Ed25519PubKey
+from tendermint_tpu.types import Validator, ValidatorSet
+from tendermint_tpu.types.block import (
+    BlockID,
+    Commit,
+    CommitSig,
+    Consensus,
+    Header,
+    PartSetHeader,
+)
+from tendermint_tpu.types.light import SignedHeader
+
+JSON_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "mbt_json")
+
+MAX_CLOCK_DRIFT = 1.0  # driver_test.go:57
+
+
+def _b(hex_or_none):
+    return bytes.fromhex(hex_or_none) if hex_or_none else b""
+
+
+def _header(d) -> Header:
+    return Header(
+        version=Consensus(
+            block=int(d["version"]["block"]), app=int(d["version"].get("app", 0))
+        ),
+        chain_id=d["chain_id"],
+        height=int(d["height"]),
+        time=parse_rfc3339(d["time"]),
+        last_block_id=_block_id(d.get("last_block_id")),
+        last_commit_hash=_b(d.get("last_commit_hash")),
+        data_hash=_b(d.get("data_hash")),
+        validators_hash=_b(d["validators_hash"]),
+        next_validators_hash=_b(d["next_validators_hash"]),
+        consensus_hash=_b(d.get("consensus_hash")),
+        app_hash=_b(d.get("app_hash")),
+        last_results_hash=_b(d.get("last_results_hash")),
+        evidence_hash=_b(d.get("evidence_hash")),
+        proposer_address=_b(d.get("proposer_address")),
+    )
+
+
+def _block_id(d) -> BlockID:
+    if not d:
+        return BlockID()
+    parts = d.get("parts") or {}
+    return BlockID(
+        _b(d.get("hash")),
+        PartSetHeader(int(parts.get("total", 0)), _b(parts.get("hash"))),
+    )
+
+
+def _commit(d) -> Commit:
+    sigs = []
+    for s in d["signatures"]:
+        sigs.append(
+            CommitSig(
+                block_id_flag=int(s["block_id_flag"]),
+                validator_address=_b(s.get("validator_address")),
+                timestamp=parse_rfc3339(s["timestamp"])
+                if s.get("timestamp")
+                else parse_rfc3339("1970-01-01T00:00:00"),
+                signature=base64.b64decode(s["signature"])
+                if s.get("signature")
+                else b"",
+            )
+        )
+    return Commit(
+        height=int(d["height"]),
+        round=int(d["round"]),
+        block_id=_block_id(d.get("block_id")),
+        signatures=sigs,
+    )
+
+
+def _valset(d) -> ValidatorSet:
+    vals = []
+    for v in d.get("validators") or []:
+        pub = Ed25519PubKey(base64.b64decode(v["pub_key"]["value"]))
+        vals.append(
+            Validator(
+                pub,
+                int(v["voting_power"]),
+                proposer_priority=int(v["proposer_priority"] or 0),
+            )
+        )
+    vset = ValidatorSet()
+    vset.validators = vals
+    if vals:
+        vset.get_proposer()
+    return vset
+
+
+def _signed_header(d) -> SignedHeader:
+    return SignedHeader(header=_header(d["header"]), commit=_commit(d["commit"]))
+
+
+def _trace_files():
+    return sorted(glob.glob(os.path.join(JSON_DIR, "*.json")))
+
+
+@pytest.mark.parametrize(
+    "path", _trace_files(), ids=[os.path.basename(p) for p in _trace_files()]
+)
+def test_mbt_trace(path):
+    with open(path) as fh:
+        tc = json.load(fh)
+    trusted_sh = _signed_header(tc["initial"]["signed_header"])
+    trusted_next_vals = _valset(tc["initial"]["next_validator_set"])
+    trusting_period = int(tc["initial"]["trusting_period"]) / 1e9  # ns -> s
+
+    for step, inp in enumerate(tc["input"]):
+        new_sh = _signed_header(inp["block"]["signed_header"])
+        new_vals = _valset(inp["block"]["validator_set"])
+        now = parse_rfc3339(inp["now"])
+        err = None
+        try:
+            verifier.verify(
+                trusted_sh,
+                trusted_next_vals,
+                new_sh,
+                new_vals,
+                trusting_period,
+                now,
+                MAX_CLOCK_DRIFT,
+            )
+        except Exception as e:  # classified below
+            err = e
+
+        verdict = inp["verdict"]
+        ctx = f"{os.path.basename(path)} step {step}"
+        if verdict == "SUCCESS":
+            assert err is None, f"{ctx}: expected SUCCESS, got {err!r}"
+        elif verdict == "NOT_ENOUGH_TRUST":
+            assert isinstance(err, NewValSetCantBeTrustedError), (
+                f"{ctx}: expected NOT_ENOUGH_TRUST, got {err!r}"
+            )
+        elif verdict == "INVALID":
+            assert isinstance(
+                err, (InvalidHeaderError, HeaderExpiredError)
+            ), f"{ctx}: expected INVALID, got {err!r}"
+        else:
+            pytest.fail(f"{ctx}: unknown verdict {verdict!r}")
+
+        if err is None:  # advance, as the reference driver does
+            trusted_sh = new_sh
+            trusted_next_vals = _valset(inp["block"]["next_validator_set"])
+
+
+def test_traces_present():
+    assert len(_trace_files()) == 9
+
+
+def test_expired_trust_root_rejected():
+    """verifier.go:47/116: expiry gates on the TRUSTED header's age — an
+    expired trust root must not anchor new updates (long-range-attack
+    window). The MBT traces cannot distinguish this (their header times
+    differ by seconds against a 1400s period), so pin it directly:
+    trusted header at t=1s, 1400s period, now just past expiry -> reject,
+    regardless of how fresh the new header is."""
+    path = os.path.join(JSON_DIR, "MC4_4_faulty_TestSuccess.json")
+    with open(path) as fh:
+        tc = json.load(fh)
+    trusted_sh = _signed_header(tc["initial"]["signed_header"])
+    trusted_next_vals = _valset(tc["initial"]["next_validator_set"])
+    trusting_period = int(tc["initial"]["trusting_period"]) / 1e9
+    inp = next(i for i in tc["input"] if i["verdict"] == "SUCCESS")
+    new_sh = _signed_header(inp["block"]["signed_header"])
+    new_vals = _valset(inp["block"]["validator_set"])
+    expired_now = parse_rfc3339("1970-01-01T00:23:22Z")  # 1s past expiry
+    with pytest.raises(HeaderExpiredError):
+        verifier.verify(
+            trusted_sh,
+            trusted_next_vals,
+            new_sh,
+            new_vals,
+            trusting_period,
+            expired_now,
+            MAX_CLOCK_DRIFT,
+        )
+
+
+def test_harness_not_vacuous():
+    """Negative control: corrupting one commit signature in a SUCCESS
+    step must flip the verdict — proving the traces actually exercise
+    signature verification, not just error-shape matching."""
+    path = os.path.join(JSON_DIR, "MC4_4_faulty_TestSuccess.json")
+    with open(path) as fh:
+        tc = json.load(fh)
+    trusted_sh = _signed_header(tc["initial"]["signed_header"])
+    trusted_next_vals = _valset(tc["initial"]["next_validator_set"])
+    trusting_period = int(tc["initial"]["trusting_period"]) / 1e9
+    inp = next(i for i in tc["input"] if i["verdict"] == "SUCCESS")
+    new_sh = _signed_header(inp["block"]["signed_header"])
+    new_vals = _valset(inp["block"]["validator_set"])
+    # flip one byte in the first real signature
+    for cs in new_sh.commit.signatures:
+        if cs.signature:
+            cs.signature = bytes([cs.signature[0] ^ 1]) + cs.signature[1:]
+            break
+    with pytest.raises(Exception):
+        verifier.verify(
+            trusted_sh,
+            trusted_next_vals,
+            new_sh,
+            new_vals,
+            trusting_period,
+            parse_rfc3339(inp["now"]),
+            MAX_CLOCK_DRIFT,
+        )
