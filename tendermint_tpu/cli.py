@@ -473,6 +473,35 @@ def cmd_debug_dump(args) -> int:
     return 0
 
 
+def cmd_compact_db(args) -> int:
+    """commands/compact.go analog: rewrite every filedb in <home>/data
+    dropping dead (overwritten/deleted) records. Run on a STOPPED node."""
+    from tendermint_tpu.storage import open_db
+
+    cfg = Config(home=args.home)
+    data = cfg.data_dir()
+    if not os.path.isdir(data):
+        raise FileNotFoundError(data)
+    names = sorted(
+        f[: -len(".fdb")] for f in os.listdir(data) if f.endswith(".fdb")
+    )
+    if not names:
+        print(f"no filedb databases in {data}")
+        return 0
+    for name in names:
+        path = os.path.join(data, name + ".fdb")
+        before = os.path.getsize(path)
+        db = open_db("filedb", data, name)
+        db.compact()
+        db.close()
+        after = os.path.getsize(path)
+        print(
+            f"{name}.fdb: {before} -> {after} bytes "
+            f"({(1 - after / before) * 100 if before else 0:.0f}% reclaimed)"
+        )
+    return 0
+
+
 def cmd_wal2json(args) -> int:
     """scripts/wal2json analog: decode a consensus WAL (all rotated
     chunks) to one JSON document per record on stdout."""
@@ -633,6 +662,11 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--rpc", default="http://127.0.0.1:26657")
     d.add_argument("--output", "-o", default="tm-debug-dump.tgz")
     d.set_defaults(fn=cmd_debug_dump)
+
+    p = sub.add_parser(
+        "compact-db", help="compact filedb databases (node stopped)"
+    )
+    p.set_defaults(fn=cmd_compact_db)
 
     p = sub.add_parser("wal2json", help="decode a consensus WAL to JSON")
     p.add_argument("wal", help="path to the WAL head file")

@@ -123,6 +123,9 @@ class CFileDB(KVStore):
         if lib is None:
             raise RuntimeError("native filedb engine unavailable")
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        from tendermint_tpu.storage.filedb import acquire_db_lock
+
+        self._flock = acquire_db_lock(path)
         self._lib = lib
         self._fsync = fsync_writes
         self._h = lib.filedb_open(path.encode())
@@ -221,3 +224,8 @@ class CFileDB(KVStore):
             if self._h:
                 self._lib.filedb_close(self._h)
                 self._h = None
+            if getattr(self, "_flock", None) is not None:
+                from tendermint_tpu.storage.filedb import release_db_lock
+
+                release_db_lock(self._flock)
+                self._flock = None

@@ -49,6 +49,40 @@ def encode_record(op: int, key: bytes, value: bytes = b"") -> bytes:
     return _HDR.pack(zlib.crc32(payload), len(payload)) + payload
 
 
+class DBLockedError(RuntimeError):
+    """Another process holds this database (tm-db's file-lock analog)."""
+
+
+def acquire_db_lock(db_path: str):
+    """Exclusive advisory lock on <db>.lock for the db's lifetime.
+
+    Two processes on one FileDB corrupt it silently: the second opener
+    (or an operator running compact-db against a RUNNING node) rewrites
+    or replaces the log while the first keeps appending to an orphaned
+    inode. Fail loudly instead."""
+    import fcntl
+
+    fh = open(db_path + ".lock", "a+")
+    try:
+        fcntl.flock(fh.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        fh.close()
+        raise DBLockedError(
+            f"database {db_path} is locked by another process "
+            "(is the node still running?)"
+        )
+    return fh
+
+
+def release_db_lock(fh) -> None:
+    import fcntl
+
+    try:
+        fcntl.flock(fh.fileno(), fcntl.LOCK_UN)
+    finally:
+        fh.close()
+
+
 class FileDB(KVStore):
     """Pure-Python engine (see module docstring for the format)."""
 
@@ -56,6 +90,7 @@ class FileDB(KVStore):
         self._path = path
         self._fsync = fsync_writes
         self._lock = threading.RLock()
+        self._flock = acquire_db_lock(path)
         self._index: Dict[bytes, Tuple[int, int]] = {}  # key -> (val off, len)
         self._keys: List[bytes] = []  # sorted
         self._garbage = 0  # count of dead (overwritten/deleted) records
@@ -197,6 +232,7 @@ class FileDB(KVStore):
                 os.fsync(self._f.fileno())
             finally:
                 self._f.close()
+                release_db_lock(self._flock)
 
     # --- compaction ------------------------------------------------------------
 

@@ -327,3 +327,24 @@ class TestDebugTools:
         finally:
             proc.terminate()
             proc.wait(timeout=10)
+
+    def test_compact_db(self, tmp_path):
+        from tendermint_tpu.storage import open_db
+
+        home = str(tmp_path / "h")
+        data = os.path.join(home, "data")
+        os.makedirs(data)
+        os.makedirs(os.path.join(home, "config"))
+        db = open_db("filedb", data, "bloat")
+        for _ in range(300):
+            db.set(b"k", b"v" * 100)  # 299 dead versions
+        db.set(b"other", b"live")
+        db.close()
+        before = os.path.getsize(os.path.join(data, "bloat.fdb"))
+        assert _run(["--home", home, "compact-db"]) == 0
+        after = os.path.getsize(os.path.join(data, "bloat.fdb"))
+        assert after < before / 10
+        db = open_db("filedb", data, "bloat")
+        assert db.get(b"k") == b"v" * 100
+        assert db.get(b"other") == b"live"
+        db.close()
