@@ -329,8 +329,14 @@ def cmd_rollback(args) -> int:
 
 
 def cmd_inspect(args) -> int:
-    """commands/inspect.go (read-only view over a stopped node's data)."""
+    """commands/inspect.go: read-only view over a STOPPED node's data.
+    Default prints a JSON summary; --serve starts the reference's
+    inspect RPC server (internal/inspect/inspect.go:31) so operators can
+    run block/commit/validators/tx_search queries against the stores
+    without booting consensus."""
     cfg = _load_cfg(args)
+    if getattr(args, "serve", ""):
+        return _inspect_serve(cfg, args.serve)
     state_store, block_store = _open_stores(cfg)
     state = state_store.load()
     out = {
@@ -353,6 +359,68 @@ def cmd_inspect(args) -> int:
             }
         )
     print(json.dumps(out, indent=2))
+    return 0
+
+
+def _inspect_serve(cfg: Config, laddr: str) -> int:
+    """Read-only RPC over the stores: the route table is the normal
+    Environment's, restricted to handlers that need no live services."""
+    from tendermint_tpu.indexer import KVIndexer
+    from tendermint_tpu.rpc.core import Environment
+    from tendermint_tpu.rpc.server import RPCServer
+    from tendermint_tpu.storage import open_db
+    from tendermint_tpu.types.genesis import GenesisDoc
+
+    genesis = GenesisDoc.from_file(cfg.genesis_file())
+    state_store, block_store = _open_stores(cfg)
+    indexer = None
+    if os.path.exists(os.path.join(cfg.data_dir(), "tx_index.fdb")):
+        indexer = KVIndexer(
+            open_db(cfg.base.db_backend, cfg.data_dir(), "tx_index")
+        )
+    state = state_store.load()
+    env = Environment(
+        genesis=genesis,
+        block_store=block_store,
+        state_store=state_store,
+        indexer=indexer,
+        get_state=lambda: state,
+        is_syncing=lambda: False,
+    )
+    read_only = {
+        name: fn
+        for name, fn in env.routes().items()
+        if name
+        in (
+            "health",
+            "blockchain",
+            "genesis",
+            "genesis_chunked",
+            "block",
+            "block_by_hash",
+            "block_results",
+            "commit",
+            "header",
+            "header_by_hash",
+            "validators",
+            "consensus_params",
+            "tx",
+            "tx_search",
+            "block_search",
+        )
+    }
+    host, _, port = laddr.rpartition(":")
+    server = RPCServer(read_only, host=host or "127.0.0.1", port=int(port))
+    stop = []
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    server.start()
+    print(f"inspect server on {server.url} (read-only)", flush=True)
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        server.stop()
     return 0
 
 
@@ -638,6 +706,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_rollback)
 
     p = sub.add_parser("inspect", help="dump stored chain state (node stopped)")
+    p.add_argument(
+        "--serve",
+        default="",
+        metavar="HOST:PORT",
+        help="serve a read-only RPC over the stores instead of printing",
+    )
     p.set_defaults(fn=cmd_inspect)
 
     p = sub.add_parser("replay", help="replay stored blocks into the app")

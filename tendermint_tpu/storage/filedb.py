@@ -90,11 +90,12 @@ class FileDB(KVStore):
         self._path = path
         self._fsync = fsync_writes
         self._lock = threading.RLock()
+        # parent dir must exist before the .lock file can be created
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._flock = acquire_db_lock(path)
         self._index: Dict[bytes, Tuple[int, int]] = {}  # key -> (val off, len)
         self._keys: List[bytes] = []  # sorted
         self._garbage = 0  # count of dead (overwritten/deleted) records
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         exists = os.path.exists(path)
         self._f = open(path, "r+b" if exists else "w+b")
         if not exists:

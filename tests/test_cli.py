@@ -348,3 +348,60 @@ class TestDebugTools:
         assert db.get(b"k") == b"v" * 100
         assert db.get(b"other") == b"live"
         db.close()
+
+    def test_inspect_serve(self, tmp_path):
+        """inspect --serve: read-only RPC over a stopped node's stores
+        (internal/inspect/inspect.go:31)."""
+        home = str(tmp_path / "h")
+        _run(["--home", home, "init", "--chain-id", "ins"])
+        _fast_genesis_overwrite(home)
+        port = _free_port_block(1)
+        cfg = Config.load(home)
+        cfg.p2p.laddr = f"127.0.0.1:{port}"
+        cfg.rpc.laddr = f"127.0.0.1:{port + 1}"
+        cfg.save()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu", "--home", home, "start"],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        try:
+            deadline = time.monotonic() + 60
+            h = -1
+            while time.monotonic() < deadline and h < 3:
+                try:
+                    h = _rpc_height(port + 1)
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            assert h >= 3
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=15)
+        # node stopped: serve the stores read-only
+        iport = _free_port_block(1)
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu", "--home", home,
+             "inspect", "--serve", f"127.0.0.1:{iport}"],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        try:
+            deadline = time.monotonic() + 30
+            doc = None
+            while time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{iport}/block?height=2", timeout=2
+                    ) as resp:
+                        doc = json.load(resp)
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            assert doc and int(doc["result"]["block"]["header"]["height"]) == 2
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{iport}/validators?height=2", timeout=5
+            ) as resp:
+                vdoc = json.load(resp)
+            assert vdoc["result"]["count"] == "1"
+        finally:
+            srv.send_signal(signal.SIGTERM)
+            srv.wait(timeout=10)
