@@ -373,8 +373,10 @@ def _inspect_serve(cfg: Config, laddr: str) -> int:
 
     genesis = GenesisDoc.from_file(cfg.genesis_file())
     state_store, block_store = _open_stores(cfg)
+    from tendermint_tpu.storage import db_exists
+
     indexer = None
-    if os.path.exists(os.path.join(cfg.data_dir(), "tx_index.fdb")):
+    if db_exists(cfg.base.db_backend, cfg.data_dir(), "tx_index"):
         indexer = KVIndexer(
             open_db(cfg.base.db_backend, cfg.data_dir(), "tx_index")
         )
@@ -538,6 +540,42 @@ def cmd_debug_dump(args) -> int:
             tar.add(path, arcname=f"dump/home/{os.path.basename(path)}")
     print(f"wrote debug dump to {out_path} ({len(bundle)} rpc docs, "
           f"{len(home_files)} home files)")
+    return 0
+
+
+def cmd_reindex_event(args) -> int:
+    """commands/reindex_event.go analog: rebuild the tx/block event index
+    from stored blocks plus the persisted FinalizeBlock responses —
+    recovers search after enabling tx_index late or losing the index db.
+    Run on a STOPPED node."""
+    from tendermint_tpu.indexer import KVIndexer
+    from tendermint_tpu.state.execution import _unmarshal_finalize_response
+    from tendermint_tpu.storage import open_db
+
+    cfg = _load_cfg(args)
+    state_store, block_store = _open_stores(cfg)
+    idx_db = open_db(cfg.base.db_backend, cfg.data_dir(), "tx_index")
+    indexer = KVIndexer(idx_db)
+    base = max(block_store.base(), 1)
+    height = block_store.height()
+    indexed_blocks = indexed_txs = skipped = 0
+    for h in range(base, height + 1):
+        block = block_store.load_block(h)
+        raw = state_store.load_finalize_block_response(h)
+        if block is None or raw is None:
+            skipped += 1
+            continue
+        fres = _unmarshal_finalize_response(raw)
+        # same single entry point the live node writes through, so the
+        # rebuilt index is byte-identical to what the node would produce
+        indexer.index_finalized_block(h, block.data.txs, fres)
+        indexed_blocks += 1
+        indexed_txs += min(len(fres.tx_results), len(block.data.txs))
+    idx_db.close()
+    print(
+        f"reindexed {indexed_blocks} blocks, {indexed_txs} txs "
+        f"({skipped} heights skipped: block or responses pruned)"
+    )
     return 0
 
 
@@ -741,6 +779,12 @@ def build_parser() -> argparse.ArgumentParser:
         "compact-db", help="compact filedb databases (node stopped)"
     )
     p.set_defaults(fn=cmd_compact_db)
+
+    p = sub.add_parser(
+        "reindex-event",
+        help="rebuild the tx/block event index from stored blocks",
+    )
+    p.set_defaults(fn=cmd_reindex_event)
 
     p = sub.add_parser("wal2json", help="decode a consensus WAL to JSON")
     p.add_argument("wal", help="path to the WAL head file")

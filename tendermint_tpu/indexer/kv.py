@@ -174,6 +174,65 @@ class KVIndexer:
                     batch.set(k, h)
         batch.write()
 
+    def index_finalized_block(self, height: int, txs, fres) -> None:
+        """Index one decided block — block events plus per-tx results —
+        in a SINGLE batch (one durable write per height). The one shared
+        entry point for the live node (node._fire_events) and the
+        offline reindex-event rebuild, so the two paths cannot diverge.
+        ``fres`` is the ABCI ResponseFinalizeBlock."""
+        txs = list(txs)
+        batch = self.db.new_batch()
+        # block events (index_block_events body, shared batch)
+        batch.set(
+            _BLOCK_HEIGHT_KEY + f"{height:020d}".encode(), str(height).encode()
+        )
+        for ev in fres.events or []:
+            if not ev.type:
+                continue
+            for attr in ev.attributes or []:
+                if not attr.index:
+                    continue
+                batch.set(
+                    _evt_key(
+                        _BLOCK_EVENT_PREFIX,
+                        f"{ev.type}.{attr.key}",
+                        attr.value,
+                        height,
+                        0,
+                    ),
+                    str(height).encode(),
+                )
+        # per-tx records + event keys (index_txs body, shared batch)
+        for i, r in enumerate(fres.tx_results):
+            if i >= len(txs):
+                break
+            tr = TxResult(height=height, index=i, tx=txs[i], result=r)
+            h = tr.hash()
+            batch.set(_TX_HASH_PREFIX + h, tr.to_json())
+            batch.set(
+                _evt_key(
+                    _TX_EVENT_PREFIX, "tx.height", str(height), height, i
+                ),
+                h,
+            )
+            for ev in r.events or []:
+                if not ev.type:
+                    continue
+                for attr in ev.attributes or []:
+                    if not attr.index:
+                        continue
+                    batch.set(
+                        _evt_key(
+                            _TX_EVENT_PREFIX,
+                            f"{ev.type}.{attr.key}",
+                            attr.value,
+                            height,
+                            i,
+                        ),
+                        h,
+                    )
+        batch.write()
+
     # -- queries --------------------------------------------------------------
 
     def get_tx(self, tx_hash: bytes) -> Optional[TxResult]:

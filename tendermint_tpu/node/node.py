@@ -513,16 +513,8 @@ class Node:
         """execution.go:600-648 fireEvents: publish NewBlock, header, one
         event per tx, and validator-set updates onto the bus."""
         if self.indexer is not None:
-            from tendermint_tpu.indexer import TxResult
-
-            self.indexer.index_block_events(block.header.height, fres.events)
-            txs_all = list(block.data.txs)
-            self.indexer.index_txs(
-                TxResult(
-                    height=block.header.height, index=i, tx=txs_all[i], result=r
-                )
-                for i, r in enumerate(fres.tx_results)
-                if i < len(txs_all)
+            self.indexer.index_finalized_block(
+                block.header.height, block.data.txs, fres
             )
         bus = self.event_bus
         bus.publish_event_new_block(

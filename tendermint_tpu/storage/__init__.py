@@ -7,6 +7,19 @@ from typing import Optional
 from tendermint_tpu.storage.kv import Batch, KVStore, MemDB
 
 
+def db_exists(backend: str, db_dir: str, name: str) -> bool:
+    """Whether a database with this backend/name already exists on disk
+    (memdb never persists). Owns the backend's naming convention so
+    callers don't re-derive file paths."""
+    if backend == "memdb":
+        return False
+    if backend in ("filedb", "filedb-c", "filedb-py"):
+        return bool(db_dir) and os.path.exists(
+            os.path.join(db_dir, name + ".fdb")
+        )
+    return False
+
+
 def open_db(backend: str, db_dir: str = "", name: str = "db") -> KVStore:
     """Backend factory — the config/db.go:29 seam.
 

@@ -405,3 +405,84 @@ class TestDebugTools:
         finally:
             srv.send_signal(signal.SIGTERM)
             srv.wait(timeout=10)
+
+    def test_reindex_event_rebuilds_lost_index(self, tmp_path):
+        """commands/reindex_event.go: wipe the tx index of a stopped
+        node, rebuild it from stored blocks + persisted FinalizeBlock
+        responses, and find a committed tx again."""
+        import base64
+        import hashlib
+
+        home = str(tmp_path / "h")
+        _run(["--home", home, "init", "--chain-id", "reidx"])
+        _fast_genesis_overwrite(home)
+        port = _free_port_block(1)
+        cfg = Config.load(home)
+        cfg.p2p.laddr = f"127.0.0.1:{port}"
+        cfg.rpc.laddr = f"127.0.0.1:{port + 1}"
+        cfg.save()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu", "--home", home, "start"],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        tx = b"reidx=1"
+        try:
+            deadline = time.monotonic() + 60
+            up = False
+            while time.monotonic() < deadline and not up:
+                try:
+                    _rpc_height(port + 1)
+                    up = True
+                except Exception:
+                    time.sleep(0.5)
+            assert up
+            body = json.dumps(
+                {
+                    "jsonrpc": "2.0", "id": 1, "method": "broadcast_tx_sync",
+                    "params": {"tx": base64.b64encode(tx).decode()},
+                }
+            ).encode()
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{port + 1}", body,
+                    {"Content-Type": "application/json"},
+                ),
+                timeout=10,
+            )
+            h = hashlib.sha256(tx).hexdigest()
+            committed = False
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not committed:
+                q = json.dumps(
+                    {"jsonrpc": "2.0", "id": 2, "method": "tx",
+                     "params": {"hash": "0x" + h}}
+                ).encode()
+                try:
+                    with urllib.request.urlopen(
+                        urllib.request.Request(
+                            f"http://127.0.0.1:{port + 1}", q,
+                            {"Content-Type": "application/json"},
+                        ),
+                        timeout=3,
+                    ) as resp:
+                        committed = "result" in json.load(resp)
+                except Exception:
+                    pass
+                if not committed:
+                    time.sleep(0.5)
+            assert committed, "tx never committed"
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=15)
+
+        # lose the index, rebuild, and find the tx offline
+        for f in os.listdir(os.path.join(home, "data")):
+            if f.startswith("tx_index"):
+                os.unlink(os.path.join(home, "data", f))
+        assert _run(["--home", home, "reindex-event"]) == 0
+        from tendermint_tpu.indexer import KVIndexer
+        from tendermint_tpu.storage import open_db
+
+        idx = KVIndexer(open_db("filedb", os.path.join(home, "data"), "tx_index"))
+        tr = idx.get_tx(hashlib.sha256(tx).digest())
+        assert tr is not None and tr.tx == tx
