@@ -543,17 +543,68 @@ def cmd_debug_dump(args) -> int:
     return 0
 
 
+def cmd_confix(args) -> int:
+    """internal/libs/confix analog: migrate a config.toml written by an
+    older version to the current schema — keys the current schema lacks
+    are dropped, missing keys gain defaults, known keys keep their
+    values. Prints a report; --dry-run skips the rewrite."""
+    import tomllib
+
+    cfg_path = Config(home=args.home).config_file()
+    with open(cfg_path, "rb") as fh:
+        old_doc = tomllib.load(fh)
+    cfg = Config.load(args.home)  # tolerant load: unknown keys ignored
+    new_text = cfg.to_toml()
+    new_doc = tomllib.loads(new_text)
+
+    def _keys(doc):
+        out = set()
+        for section, table in doc.items():
+            if isinstance(table, dict):
+                out.update(f"{section}.{k}" for k in table)
+            else:
+                out.add(section)
+        return out
+
+    old_keys, new_keys = _keys(old_doc), _keys(new_doc)
+    dropped = sorted(old_keys - new_keys)
+    added = sorted(new_keys - old_keys)
+    for key in dropped:
+        print(f"  - {key} (unknown to this version; dropped)")
+    for key in added:
+        print(f"  + {key} (new; default applied)")
+    if not dropped and not added:
+        print("config already matches the current schema")
+        return 0
+    if getattr(args, "dry_run", False):
+        print("dry run: config not rewritten")
+        return 0
+    backup = cfg_path + ".bak"
+    shutil.copyfile(cfg_path, backup)
+    cfg.save()
+    print(f"rewrote {cfg_path} (backup at {backup})")
+    return 0
+
+
 def cmd_reindex_event(args) -> int:
     """commands/reindex_event.go analog: rebuild the tx/block event index
     from stored blocks plus the persisted FinalizeBlock responses —
     recovers search after enabling tx_index late or losing the index db.
     Run on a STOPPED node."""
     from tendermint_tpu.indexer import KVIndexer
-    from tendermint_tpu.state.execution import _unmarshal_finalize_response
-    from tendermint_tpu.storage import open_db
+    from tendermint_tpu.storage import db_exists, open_db
 
     cfg = _load_cfg(args)
     state_store, block_store = _open_stores(cfg)
+    if db_exists(cfg.base.db_backend, cfg.data_dir(), "tx_index"):
+        # Rebuild from scratch: merging into a stale index would keep
+        # phantom records for blocks discarded by rollback. The probe
+        # open proves no node holds the db before we delete it.
+        probe = open_db(cfg.base.db_backend, cfg.data_dir(), "tx_index")
+        probe.close()
+        for f in os.listdir(cfg.data_dir()):
+            if f.startswith("tx_index"):
+                os.unlink(os.path.join(cfg.data_dir(), f))
     idx_db = open_db(cfg.base.db_backend, cfg.data_dir(), "tx_index")
     indexer = KVIndexer(idx_db)
     base = max(block_store.base(), 1)
@@ -561,11 +612,10 @@ def cmd_reindex_event(args) -> int:
     indexed_blocks = indexed_txs = skipped = 0
     for h in range(base, height + 1):
         block = block_store.load_block(h)
-        raw = state_store.load_finalize_block_response(h)
-        if block is None or raw is None:
+        fres = state_store.load_decoded_finalize_block_response(h)
+        if block is None or fres is None:
             skipped += 1
             continue
-        fres = _unmarshal_finalize_response(raw)
         # same single entry point the live node writes through, so the
         # rebuilt index is byte-identical to what the node would produce
         indexer.index_finalized_block(h, block.data.txs, fres)
@@ -785,6 +835,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="rebuild the tx/block event index from stored blocks",
     )
     p.set_defaults(fn=cmd_reindex_event)
+
+    p = sub.add_parser(
+        "confix", help="migrate config.toml to the current schema"
+    )
+    p.add_argument("--dry-run", action="store_true")
+    p.set_defaults(fn=cmd_confix)
 
     p = sub.add_parser("wal2json", help="decode a consensus WAL to JSON")
     p.add_argument("wal", help="path to the WAL head file")

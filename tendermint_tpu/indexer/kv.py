@@ -134,59 +134,11 @@ class KVIndexer:
 
     # -- indexing -------------------------------------------------------------
 
-    def index_block_events(self, height: int, events: List[abci.Event]) -> None:
-        batch = self.db.new_batch()
-        batch.set(_BLOCK_HEIGHT_KEY + f"{height:020d}".encode(), str(height).encode())
-        for ev in events or []:
-            if not ev.type:
-                continue
-            for attr in ev.attributes or []:
-                if not attr.index:
-                    continue
-                k = _evt_key(
-                    _BLOCK_EVENT_PREFIX, f"{ev.type}.{attr.key}", attr.value, height, 0
-                )
-                batch.set(k, str(height).encode())
-        batch.write()
-
-    def index_txs(self, results: Iterable[TxResult]) -> None:
-        batch = self.db.new_batch()
-        for tr in results:
-            h = tr.hash()
-            batch.set(_TX_HASH_PREFIX + h, tr.to_json())
-            batch.set(
-                _evt_key(_TX_EVENT_PREFIX, "tx.height", str(tr.height), tr.height, tr.index),
-                h,
-            )
-            for ev in tr.result.events or []:
-                if not ev.type:
-                    continue
-                for attr in ev.attributes or []:
-                    if not attr.index:
-                        continue
-                    k = _evt_key(
-                        _TX_EVENT_PREFIX,
-                        f"{ev.type}.{attr.key}",
-                        attr.value,
-                        tr.height,
-                        tr.index,
-                    )
-                    batch.set(k, h)
-        batch.write()
-
-    def index_finalized_block(self, height: int, txs, fres) -> None:
-        """Index one decided block — block events plus per-tx results —
-        in a SINGLE batch (one durable write per height). The one shared
-        entry point for the live node (node._fire_events) and the
-        offline reindex-event rebuild, so the two paths cannot diverge.
-        ``fres`` is the ABCI ResponseFinalizeBlock."""
-        txs = list(txs)
-        batch = self.db.new_batch()
-        # block events (index_block_events body, shared batch)
+    def _put_block_events(self, batch, height: int, events) -> None:
         batch.set(
             _BLOCK_HEIGHT_KEY + f"{height:020d}".encode(), str(height).encode()
         )
-        for ev in fres.events or []:
+        for ev in events or []:
             if not ev.type:
                 continue
             for attr in ev.attributes or []:
@@ -202,35 +154,59 @@ class KVIndexer:
                     ),
                     str(height).encode(),
                 )
-        # per-tx records + event keys (index_txs body, shared batch)
+
+    def _put_tx(self, batch, tr: "TxResult") -> None:
+        h = tr.hash()
+        batch.set(_TX_HASH_PREFIX + h, tr.to_json())
+        batch.set(
+            _evt_key(
+                _TX_EVENT_PREFIX, "tx.height", str(tr.height), tr.height, tr.index
+            ),
+            h,
+        )
+        for ev in tr.result.events or []:
+            if not ev.type:
+                continue
+            for attr in ev.attributes or []:
+                if not attr.index:
+                    continue
+                batch.set(
+                    _evt_key(
+                        _TX_EVENT_PREFIX,
+                        f"{ev.type}.{attr.key}",
+                        attr.value,
+                        tr.height,
+                        tr.index,
+                    ),
+                    h,
+                )
+
+    def index_block_events(self, height: int, events: List[abci.Event]) -> None:
+        batch = self.db.new_batch()
+        self._put_block_events(batch, height, events)
+        batch.write()
+
+    def index_txs(self, results: Iterable[TxResult]) -> None:
+        batch = self.db.new_batch()
+        for tr in results:
+            self._put_tx(batch, tr)
+        batch.write()
+
+    def index_finalized_block(self, height: int, txs, fres) -> None:
+        """Index one decided block — block events plus per-tx results —
+        in a SINGLE batch (one durable write per height). The one shared
+        entry point for the live node (node._fire_events) and the
+        offline reindex-event rebuild, so the two paths cannot diverge.
+        ``fres`` is the ABCI ResponseFinalizeBlock."""
+        txs = list(txs)
+        batch = self.db.new_batch()
+        self._put_block_events(batch, height, fres.events)
         for i, r in enumerate(fres.tx_results):
             if i >= len(txs):
                 break
-            tr = TxResult(height=height, index=i, tx=txs[i], result=r)
-            h = tr.hash()
-            batch.set(_TX_HASH_PREFIX + h, tr.to_json())
-            batch.set(
-                _evt_key(
-                    _TX_EVENT_PREFIX, "tx.height", str(height), height, i
-                ),
-                h,
+            self._put_tx(
+                batch, TxResult(height=height, index=i, tx=txs[i], result=r)
             )
-            for ev in r.events or []:
-                if not ev.type:
-                    continue
-                for attr in ev.attributes or []:
-                    if not attr.index:
-                        continue
-                    batch.set(
-                        _evt_key(
-                            _TX_EVENT_PREFIX,
-                            f"{ev.type}.{attr.key}",
-                            attr.value,
-                            height,
-                            i,
-                        ),
-                        h,
-                    )
         batch.write()
 
     # -- queries --------------------------------------------------------------

@@ -296,6 +296,19 @@ class StateStore:
     def load_finalize_block_response(self, height: int) -> Optional[bytes]:
         return self._db.get(_abci_responses_key(height))
 
+    def load_decoded_finalize_block_response(self, height: int):
+        """The stored FinalizeBlock response as an abci object, or None —
+        the public seam replay/reindex/RPC share (store.go
+        LoadFinalizeBlockResponses)."""
+        raw = self.load_finalize_block_response(height)
+        if raw is None:
+            return None
+        from tendermint_tpu.state.execution import (
+            _unmarshal_finalize_response,
+        )
+
+        return _unmarshal_finalize_response(raw)
+
     def prune_states(self, retain_height: int) -> None:
         """store.go PruneStates: drop valsets/params/responses below height."""
         for prefix, keyfn in (

@@ -46,4 +46,4 @@ def open_db(backend: str, db_dir: str = "", name: str = "db") -> KVStore:
     raise ValueError(f"unknown db backend {backend!r}")
 
 
-__all__ = ["Batch", "KVStore", "MemDB", "open_db"]
+__all__ = ["Batch", "KVStore", "MemDB", "db_exists", "open_db"]

@@ -486,3 +486,31 @@ class TestDebugTools:
         idx = KVIndexer(open_db("filedb", os.path.join(home, "data"), "tx_index"))
         tr = idx.get_tx(hashlib.sha256(tx).digest())
         assert tr is not None and tr.tx == tx
+
+    def test_confix_migrates_schema(self, tmp_path, capsys):
+        home = str(tmp_path / "h")
+        _run(["--home", home, "init", "--chain-id", "cfx"])
+        capsys.readouterr()
+        path = Config(home=home).config_file()
+        text = open(path).read()
+        text = text.replace('log_level = "info"\n', "")  # missing new key
+        text = text.replace(
+            "[p2p]", "obsolete_flag = true\n\n[p2p]", 1
+        )  # dead key
+        open(path, "w").write(text)
+        assert _run(["--home", home, "confix", "--dry-run"]) == 0
+        out = capsys.readouterr().out
+        assert "obsolete_flag" in out and "log_level" in out
+        assert 'log_level = "info"' not in open(path).read()  # not rewritten
+        assert _run(["--home", home, "confix"]) == 0
+        capsys.readouterr()
+        migrated = open(path).read()
+        assert 'log_level = "info"' in migrated
+        assert "obsolete_flag" not in migrated
+        assert os.path.exists(path + ".bak")
+        # idempotent
+        assert _run(["--home", home, "confix"]) == 0
+        assert "already matches" in capsys.readouterr().out
+        # node still starts from the migrated config
+        loaded = Config.load(home)
+        assert loaded.base.log_level == "info"
