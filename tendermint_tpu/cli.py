@@ -3,14 +3,21 @@
 The cmd/tendermint analog (main.go:29-61). Commands:
 
   init            scaffold a home dir (config.toml, genesis, keys)
-  start           run a node from a home dir until interrupted
+  start           run a node (or a PEX-only seed with mode="seed")
   testnet         generate N localhost validator home dirs
   show-node-id    print the p2p identity
   show-validator  print the validator pubkey JSON
   unsafe-reset-all  wipe chain data, keep keys (reset privval state)
   rollback        roll state back one height (rollback.go)
-  inspect         print chain state from a STOPPED node's data dir
+  inspect         chain state of a STOPPED node (JSON, or --serve RPC)
   replay          re-sync the ABCI app from the block store (Handshaker)
+  light           light-client RPC proxy verified from a trust anchor
+  debug dump      diagnostic tarball from a RUNNING node
+  wal2json        decode a consensus WAL to JSON records
+  abci            drive an ABCI socket app (info/echo/query/check-tx)
+  compact-db      drop dead filedb records (node stopped)
+  reindex-event   rebuild the tx/block index from stored blocks
+  confix          migrate config.toml to the current schema
 
 Every command takes ``--home`` (default ``~/.tendermint_tpu``). The node
 stack is the library's own — no pytest involved — which is the round-2
