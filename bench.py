@@ -218,6 +218,20 @@ def child_main() -> None:
 def _run_child(env_overrides, timeout):
     env = dict(os.environ)
     env.update(env_overrides)
+    if env.get("BENCH_FORCE_CPU") == "1":
+        # The axon site hook (a PYTHONPATH sitecustomize) can BLOCK the
+        # child at `import jax` when the TPU relay is down — observed
+        # 2026-07-30, scripts/TPU_PROBE_LOG.md. The CPU fallback must be
+        # immune to accelerator infrastructure: drop only hook-bearing
+        # PYTHONPATH entries (keep any legitimate dependency paths) and
+        # force the CPU platform outright.
+        kept = [
+            p
+            for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and not os.path.exists(os.path.join(p, "sitecustomize.py"))
+        ]
+        env["PYTHONPATH"] = os.pathsep.join([REPO] + kept)
+        env["JAX_PLATFORMS"] = "cpu"
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child"],
