@@ -219,19 +219,14 @@ def _run_child(env_overrides, timeout):
     env = dict(os.environ)
     env.update(env_overrides)
     if env.get("BENCH_FORCE_CPU") == "1":
-        # The axon site hook (a PYTHONPATH sitecustomize) can BLOCK the
-        # child at `import jax` when the TPU relay is down — observed
-        # 2026-07-30, scripts/TPU_PROBE_LOG.md. The CPU fallback must be
-        # immune to accelerator infrastructure: drop only hook-bearing
-        # PYTHONPATH entries (keep any legitimate dependency paths) and
-        # force the CPU platform outright.
-        kept = [
-            p
-            for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and not os.path.exists(os.path.join(p, "sitecustomize.py"))
-        ]
-        env["PYTHONPATH"] = os.pathsep.join([REPO] + kept)
-        env["JAX_PLATFORMS"] = "cpu"
+        # The CPU fallback must be immune to accelerator infrastructure
+        # (the axon site hook can block `import jax` when the TPU relay
+        # is down); one shared policy with the dryrun child.
+        import __graft_entry__
+
+        hook_free = __graft_entry__.hook_free_cpu_env()
+        env["PYTHONPATH"] = hook_free["PYTHONPATH"]
+        env["JAX_PLATFORMS"] = hook_free["JAX_PLATFORMS"]
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child"],
