@@ -111,7 +111,10 @@ def _stage_breakdown(pks, msgs, sigs):
 
             fns.append(pallas_verify.compiled_verify(n_chunk))
         else:
-            fns.append(ed25519_batch._compiled_kernel(n_chunk, None))
+            from tendermint_tpu.ops import field32
+
+            mul_impl = "mxu" if impl == "mxu" else field32.get_mul_impl()
+            fns.append(ed25519_batch._compiled_kernel(n_chunk, None, mul_impl))
     outs = [fn(*args) for fn, args in zip(fns, dev)]  # warmup/compile
     for o in outs:
         o.block_until_ready()
@@ -288,6 +291,15 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # --impl=mxu|xla|pallas|auto pins the verifier implementation for
+    # both parent and child (the int8-MXU contraction is bench.py
+    # --impl=mxu; default remains auto). Inherited via the environment.
+    for arg in sys.argv[1:]:
+        if arg.startswith("--impl="):
+            impl = arg.split("=", 1)[1]
+            if impl not in ("mxu", "xla", "pallas", "auto"):
+                sys.exit(f"--impl must be one of mxu|xla|pallas|auto, got {impl!r}")
+            os.environ["TENDERMINT_TPU_VERIFY_IMPL"] = impl
     if "--child" in sys.argv[1:]:
         child_main()
     else:

@@ -1,11 +1,40 @@
 #!/bin/bash
-# Probe the axon TPU tunnel until it answers; log timestamps.
-for i in $(seq 1 60); do
-  if timeout 90 python -u -c "import jax; print(jax.devices())" >/tmp/tpu_probe.log 2>&1; then
-    echo "$(date +%T) TPU BACK after attempt $i" >> /tmp/tpu_probe.log
-    exit 0
+# Probe the axon TPU tunnel until it answers with a FRESH H2D transfer
+# (cached-buffer re-execution lies — see scripts/TPU_PROBE_LOG.md), then
+# immediately run bench.py to capture a real-chip number for the round.
+# Keeps probing after a success so later-built impls (mxu) get measured too.
+LOG=/root/repo/scripts/TPU_PROBE_LOG.md
+for i in $(seq 1 200); do
+  if timeout 90 python -u -c "
+import numpy as np, jax
+x = np.random.randint(0,255,(1024,32),dtype=np.uint8)
+d = jax.device_put(x); d.block_until_ready()
+plat = list(d.devices())[0].platform
+assert plat not in ('cpu',), plat
+print('H2D ok on', plat)
+" >/tmp/tpu_probe.log 2>&1; then
+    echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ) — probe loop: chip ALIVE (fresh H2D ok), attempt $i; launching bench" >> "$LOG"
+    # BENCH_TIMEOUT=700 keeps primary attempt + CPU fallback under the
+    # outer 1800s kill; a CPU-fallback result must NOT be published as a
+    # live-chip number, so gate the copy on the backend field.
+    ( cd /root/repo && timeout 1800 env BENCH_TIMEOUT=700 python bench.py > /tmp/bench_live.json 2>/tmp/bench_live.err
+      rc=$?
+      if [ $rc -eq 0 ] && grep -q '"backend": *"\(tpu\|axon\)"' /tmp/bench_live.json; then
+        cp /tmp/bench_live.json /root/repo/BENCH_live.json
+        echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ) — probe-loop bench SUCCEEDED on chip: $(tail -1 /tmp/bench_live.json)" >> "$LOG"
+        # Also measure the int8-MXU formulation on the live chip.
+        timeout 1800 env BENCH_SKIP_COMMIT=1 BENCH_TIMEOUT=700 python bench.py --impl=mxu > /tmp/bench_mxu.json 2>/tmp/bench_mxu.err
+        if [ $? -eq 0 ] && grep -q '"backend": *"\(tpu\|axon\)"' /tmp/bench_mxu.json; then
+          cp /tmp/bench_mxu.json /root/repo/BENCH_live_mxu.json
+          echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ) — probe-loop bench --impl=mxu on chip: $(tail -1 /tmp/bench_mxu.json)" >> "$LOG"
+        else
+          echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ) — probe-loop bench --impl=mxu failed or fell back to cpu" >> "$LOG"
+        fi
+      else
+        echo "- $(date -u +%Y-%m-%dT%H:%M:%SZ) — probe-loop bench rc=$rc (failed or cpu fallback; not published)" >> "$LOG"
+      fi )
+    sleep 600
+  else
+    sleep 150
   fi
-  echo "$(date +%T) attempt $i failed" >> /tmp/tpu_probe.log
-  sleep 120
 done
-exit 1

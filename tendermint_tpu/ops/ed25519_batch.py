@@ -234,8 +234,23 @@ _enable_persistent_cache()
 
 
 @lru_cache(maxsize=16)
-def _compiled_kernel(n: int, backend: Optional[str]):
-    return jax.jit(verify_kernel, backend=backend)
+def _compiled_kernel(n: int, backend: Optional[str], mul_impl: str = "vpu"):
+    """One compiled verifier per (padded size, backend, field-mul impl).
+
+    The field-mul impl ("vpu" f32 shifts vs "mxu" int8 dot_general —
+    see ops/field_mxu.py) is a trace-time switch on field32, so it is
+    pinned here around the trace and must be part of the cache key.
+    """
+
+    def run(pk, r, s, k):
+        prev = field.get_mul_impl()
+        field.set_mul_impl(mul_impl)
+        try:
+            return verify_kernel(pk, r, s, k)
+        finally:
+            field.set_mul_impl(prev)
+
+    return jax.jit(run, backend=backend)
 
 
 # --- implementation dispatch (XLA graph vs Pallas kernel) -------------------
@@ -244,7 +259,9 @@ def _compiled_kernel(n: int, backend: Optional[str]):
 # intermediate in VMEM; the XLA graph materializes them to HBM. On TPU
 # backends the Pallas path is the default; CPU stays on the XLA graph
 # (Pallas interpret mode is a test vehicle, far too slow for real
-# batches). TENDERMINT_TPU_VERIFY_IMPL=pallas|xla|auto overrides.
+# batches). TENDERMINT_TPU_VERIFY_IMPL=pallas|xla|mxu|auto overrides;
+# "mxu" is the XLA graph with field multiplies as int8 dot_general
+# contractions (ops/field_mxu.py) instead of f32 VPU shifts.
 
 _IMPL_ENV = "TENDERMINT_TPU_VERIFY_IMPL"
 _PALLAS_BROKEN = False  # sticky per-process fallback after a failure
@@ -276,6 +293,8 @@ def active_impl(backend: Optional[str] = None) -> str:
     import os
 
     mode = os.environ.get(_IMPL_ENV, "auto").lower()
+    if mode == "mxu":
+        return "mxu"
     if mode == "xla" or _PALLAS_BROKEN:
         return "xla"
     if mode == "pallas":
@@ -292,7 +311,8 @@ def _run_chunk(inputs: dict, lo: int, hi: int, backend: Optional[str]):
         jnp.asarray(inputs["s"][lo:hi]),
         jnp.asarray(inputs["k"][lo:hi]),
     )
-    if active_impl(backend) == "pallas":
+    impl = active_impl(backend)
+    if impl == "pallas":
         try:
             from tendermint_tpu.ops import pallas_verify
 
@@ -304,7 +324,11 @@ def _run_chunk(inputs: dict, lo: int, hi: int, backend: Optional[str]):
             warnings.warn(
                 f"pallas verifier failed ({exc!r}); falling back to XLA graph"
             )
-    return _compiled_kernel(hi - lo, backend)(*args)
+    # TENDERMINT_TPU_VERIFY_IMPL=mxu forces the int8 contraction; the
+    # field-level default (field32.set_mul_impl / TENDERMINT_TPU_FIELD_MUL)
+    # is honored otherwise.
+    mul_impl = "mxu" if impl == "mxu" else field.get_mul_impl()
+    return _compiled_kernel(hi - lo, backend, mul_impl)(*args)
 
 
 # --- host-side preparation --------------------------------------------------

@@ -134,6 +134,26 @@ def fe_neg(a: jnp.ndarray) -> jnp.ndarray:
     return _carry_round(jnp.asarray(BIAS_FE) - a)
 
 
+# Which multiply formulation fe_mul traces: "vpu" = the f32 shifted
+# multiply-adds below; "mxu" = the int8 dot_general contraction in
+# :mod:`field_mxu`. Read at TRACE time — compiled-kernel caches must key
+# on it (ops/ed25519_batch._compiled_kernel does).
+import os as _os
+
+_MUL_IMPL = _os.environ.get("TENDERMINT_TPU_FIELD_MUL", "vpu")
+
+
+def set_mul_impl(impl: str) -> None:
+    global _MUL_IMPL
+    if impl not in ("vpu", "mxu"):
+        raise ValueError(f"unknown field mul impl {impl!r}")
+    _MUL_IMPL = impl
+
+
+def get_mul_impl() -> str:
+    return _MUL_IMPL
+
+
 def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Exact schoolbook product with the 2^256 ≡ 38 fold.
 
@@ -141,7 +161,14 @@ def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     8-bit digit + carry so the * 38 fold terms stay < 2^20 and the
     folded low columns < 2^23.1 — inside f32's exact range. Output
     limbs <= 293 (see fe_carry).
+
+    With ``set_mul_impl("mxu")`` the product columns are instead
+    computed as an int8 x int8 -> int32 dot_general (see field_mxu).
     """
+    if _MUL_IMPL == "mxu":
+        from tendermint_tpu.ops.field_mxu import fe_mul_mxu
+
+        return fe_mul_mxu(a, b)
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape)
     b = jnp.broadcast_to(b, shape)
