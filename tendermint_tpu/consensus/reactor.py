@@ -16,6 +16,7 @@ proto payloads for types).
 
 from __future__ import annotations
 
+import queue
 import struct
 import threading
 import time
@@ -111,6 +112,202 @@ def decode_vote_set_bits(payload: bytes):
     return height, round_, type_, ba
 
 
+class VotePreverifier:
+    """Scheduler-batched signature pre-verification for the vote channel.
+
+    Peer votes arrive on the reactor's vote-channel thread while the
+    single-threaded state loop consumes them one at a time; verifying
+    inline there serializes every signature onto the host. This stage
+    instead submits each vote's signature(s) to the shared
+    accumulate-with-deadline scheduler (crypto/scheduler.py -> device
+    batch verify) and forwards the vote to the state machine once its
+    batch flushed, marked pre-verified so VoteSet.add_vote (and the
+    extension check in addVote) skip the redundant inline verify.
+    Reference seam: types/vote_set.go:211-222, types/validation.go:12-16.
+
+    Strictly an optimization, never a gate: a vote whose validator can't
+    be resolved (height transition race, catch-up vote), whose key type
+    isn't batchable, or whose batch verdict is negative is forwarded
+    UNMARKED and re-verified inline by the state loop — fail-open, so a
+    racy validator-set read can never drop a valid vote. The single
+    forwarder thread preserves order among batched votes (passthrough
+    votes may overtake queued ones; consensus tolerates reordering).
+    """
+
+    QUEUE_MAX = 4096
+    # Per-vote verdict deadline, anchored at ENQUEUE time: when a flush
+    # wedges (device hang), every queued vote fails open ~together after
+    # one deadline, instead of serializing a full wait per vote.
+    WAIT_DEADLINE = 5.0
+
+    def __init__(self, cs: ConsensusState):
+        self.cs = cs
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.QUEUE_MAX)
+        self._stop_flag = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Until the batch engine is warm (first kernel compile can take
+        # tens of seconds), votes pass straight through to the inline
+        # path — pre-verification is an optimization, and a cold cache
+        # must never add latency to consensus.
+        self._warm = threading.Event()
+        self._rewarming = threading.Lock()
+        self._deadline_misses = 0  # consecutive; device likely wedged
+        # observability (tested): how many votes went through the batch
+        # path vs fell through to inline.
+        self.batched = 0
+        self.passthrough = 0
+
+    def start(self) -> None:
+        self._stop_flag.clear()
+        self._thread = threading.Thread(
+            target=self._forward_loop, name="vote-preverify", daemon=True
+        )
+        self._thread.start()
+        threading.Thread(
+            target=self._warmup, name="vote-preverify-warmup", daemon=True
+        ).start()
+
+    def _warmup(self) -> None:
+        """Compile/warm the batch engine off the hot path; flip _warm
+        only once a known-good verify round-trips. Also the re-warm
+        probe after a cold flip: only one attempt runs at a time."""
+        from tendermint_tpu.crypto.batch import get_shared_scheduler
+        from tendermint_tpu.ops.ed25519_batch import _PAD_MSG, _PAD_PK, _PAD_SIG
+
+        if not self._rewarming.acquire(blocking=False):
+            return
+        try:
+            if get_shared_scheduler().verify(
+                _PAD_PK, _PAD_MSG, _PAD_SIG, timeout=120.0
+            ):
+                self._deadline_misses = 0
+                self._warm.set()
+        except Exception:
+            pass  # engine unusable: stay cold, inline path serves forever
+        finally:
+            self._rewarming.release()
+
+    # Consecutive verdict-deadline misses before the preverifier goes
+    # cold again (stops feeding a wedged device so the scheduler's
+    # pending list cannot grow without bound) and re-probes.
+    MISS_LIMIT = 4
+
+    def stop(self) -> None:
+        self._stop_flag.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        # drain: forward stragglers unmarked so no vote is lost
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            self.cs.add_vote_from_peer(item[0], item[1])
+
+    def _resolve_pub_key(self, vote: Vote):
+        """Expected signer for this vote, or None when not resolvable
+        without the state lock's guarantees (then the state loop's
+        inline verify — which holds the lock — decides)."""
+        rs = self.cs.rs
+        if vote.height != rs.height or rs.validators is None:
+            return None
+        val = rs.validators.get_by_index(vote.validator_index)
+        if val is None or val.pub_key.address() != vote.validator_address:
+            return None
+        return val.pub_key
+
+    def submit(self, vote: Vote, peer_id: str) -> None:
+        from tendermint_tpu.crypto.batch import get_shared_scheduler
+        from tendermint_tpu.crypto.keys import ED25519_KEY_TYPE
+
+        pub_key = self._resolve_pub_key(vote)
+        if (
+            not self._warm.is_set()
+            or pub_key is None
+            or pub_key.type != ED25519_KEY_TYPE
+        ):
+            self.passthrough += 1
+            self.cs.add_vote_from_peer(vote, peer_id)
+            return
+        chain_id = self.cs.state.chain_id
+        if self._q.full():
+            # Backpressure: don't pay scheduler submission for a vote
+            # that can't be queued (submit() is the sole producer, so
+            # this check is race-free).
+            self.passthrough += 1
+            self.cs.add_vote_from_peer(vote, peer_id)
+            return
+        try:
+            sched = get_shared_scheduler()
+            handle = sched.submit(
+                pub_key.bytes(), vote.sign_bytes(chain_id), vote.signature
+            )
+            ext_handle = None
+            if (
+                vote.type == SIGNED_MSG_TYPE_PRECOMMIT
+                and not vote.block_id.is_nil()
+                and vote.extension_signature
+            ):
+                ext_handle = sched.submit(
+                    pub_key.bytes(),
+                    vote.extension_sign_bytes(chain_id),
+                    vote.extension_signature,
+                )
+            self._q.put_nowait(
+                (vote, peer_id, pub_key, handle, ext_handle, time.monotonic())
+            )
+        except (RuntimeError, queue.Full):
+            # scheduler stopped or backpressure: inline path takes over
+            self.passthrough += 1
+            self.cs.add_vote_from_peer(vote, peer_id)
+
+    def _forward_loop(self) -> None:
+        from tendermint_tpu.crypto.batch import get_shared_scheduler
+
+        while not self._stop_flag.is_set():
+            try:
+                vote, peer_id, pub_key, handle, ext_handle, t_enq = self._q.get(
+                    timeout=0.1
+                )
+            except queue.Empty:
+                continue
+            sched = get_shared_scheduler()
+            deadline = t_enq + self.WAIT_DEADLINE
+            ok = sched.wait(
+                handle, timeout=max(0.0, deadline - time.monotonic())
+            )
+            ext_ok = (
+                sched.wait(
+                    ext_handle, timeout=max(0.0, deadline - time.monotonic())
+                )
+                if ext_handle is not None
+                else None
+            )
+            if ok:
+                self.batched += 1
+                self._deadline_misses = 0
+                vote.mark_pre_verified(
+                    self.cs.state.chain_id,
+                    pub_key.bytes(),
+                    extension_too=bool(ext_ok),
+                )
+            else:
+                self.passthrough += 1
+                # Distinguish a verdict (flush ran, signature bad) from a
+                # deadline miss (flush never returned — device wedged).
+                if not handle.done.is_set():
+                    self._deadline_misses += 1
+                    if self._deadline_misses >= self.MISS_LIMIT:
+                        self._warm.clear()
+                        threading.Thread(
+                            target=self._warmup,
+                            name="vote-preverify-rewarm",
+                            daemon=True,
+                        ).start()
+            self.cs.add_vote_from_peer(vote, peer_id)
+
+
 class ConsensusReactor(Broadcaster):
     def __init__(self, cs: ConsensusState, router: Router):
         self.cs = cs
@@ -120,6 +317,7 @@ class ConsensusReactor(Broadcaster):
         self.vote_ch = router.open_channel(VOTE_CHANNEL)
         self.vote_bits_ch = router.open_channel(VOTE_SET_BITS_CHANNEL)
         cs.broadcaster = self
+        self.preverifier = VotePreverifier(cs)
         self._stop_flag = threading.Event()
         self._threads = []
         self._peers: Dict[str, PeerState] = {}
@@ -128,6 +326,7 @@ class ConsensusReactor(Broadcaster):
 
     def start(self) -> None:
         self._stop_flag.clear()
+        self.preverifier.start()
         for ch, handler in (
             (self.state_ch, self._handle_state),
             (self.data_ch, self._handle_data),
@@ -148,8 +347,11 @@ class ConsensusReactor(Broadcaster):
 
     def stop(self) -> None:
         self._stop_flag.set()
+        # Join the channel handlers FIRST: a vote-channel thread still in
+        # _handle_vote must not enqueue into a preverifier being drained.
         for t in self._threads:
             t.join(timeout=2)
+        self.preverifier.stop()
         self._threads.clear()
         with self._peers_mtx:
             gossipers = list(self._gossip_threads.values())
@@ -466,7 +668,9 @@ class ConsensusReactor(Broadcaster):
         self._peer(env.from_peer).set_has_vote(
             vote.height, vote.round, vote.type, vote.validator_index
         )
-        self.cs.add_vote_from_peer(vote, env.from_peer)
+        # Batch the signature check on the device before the state loop
+        # sees the vote (fail-open: see VotePreverifier).
+        self.preverifier.submit(vote, env.from_peer)
 
     def _handle_vote_bits(self, env: Envelope) -> None:
         if not env.message or env.message[0] != TAG_VOTE_SET_BITS:

@@ -123,6 +123,17 @@ def get_shared_scheduler():
             from tendermint_tpu.crypto.scheduler import VerifyScheduler
 
             def _verify(pks, msgs, sigs):
+                # Same small-batch policy as Ed25519BatchVerifier: below
+                # the device threshold a launch costs more than it saves
+                # — at steady-state vote rates flushes are 1-2 entries
+                # and must stay on the host; only floods hit the device.
+                if len(pks) < 16:
+                    from tendermint_tpu.crypto.ed25519_ref import verify_zip215
+
+                    return [
+                        verify_zip215(p, m, s)
+                        for p, m, s in zip(pks, msgs, sigs)
+                    ]
                 from tendermint_tpu.ops import verify_batch
 
                 return verify_batch(pks, msgs, sigs)

@@ -565,6 +565,25 @@ class Vote:
     signature: bytes = b""
     extension: bytes = b""
     extension_signature: bytes = b""
+    # Pre-verification tags set by the reactor's scheduler-batched vote
+    # path (consensus/reactor.py VotePreverifier): the (chain_id, pubkey
+    # bytes) this vote's signature(s) were already verified against via
+    # the device batch. verify() honors a matching tag and re-verifies
+    # inline otherwise, so a stale or wrong tag only costs the
+    # optimization, never correctness.
+    _pre_verified: Optional[tuple] = dc_field(
+        default=None, compare=False, repr=False
+    )
+    _pre_verified_ext: Optional[tuple] = dc_field(
+        default=None, compare=False, repr=False
+    )
+
+    def mark_pre_verified(
+        self, chain_id: str, pub_key_bytes: bytes, extension_too: bool = False
+    ) -> None:
+        self._pre_verified = (chain_id, pub_key_bytes)
+        if extension_too:
+            self._pre_verified_ext = (chain_id, pub_key_bytes)
 
     def is_nil_vote(self) -> bool:
         return self.block_id.is_nil()
@@ -611,6 +630,8 @@ class Vote:
         """types/vote.go Verify: address match + signature over sign-bytes."""
         if pub_key.address() != self.validator_address:
             raise VoteError("invalid validator address")
+        if self._pre_verified == (chain_id, pub_key.bytes()):
+            return  # already verified against this exact key via batch
         if not pub_key.verify_signature(self.sign_bytes(chain_id), self.signature):
             raise VoteError("invalid signature")
 
@@ -622,13 +643,12 @@ class Vote:
             self.type == SIGNED_MSG_TYPE_PRECOMMIT
             and not self.block_id.is_nil()
         ):
-            if not pub_key.verify_signature(
-                self.extension_sign_bytes(chain_id), self.extension_signature
-            ):
-                raise VoteError("invalid extension signature")
+            self.verify_extension(chain_id, pub_key)
 
     def verify_extension(self, chain_id: str, pub_key: PubKey) -> None:
         if self.type != SIGNED_MSG_TYPE_PRECOMMIT or self.block_id.is_nil():
+            return
+        if self._pre_verified_ext == (chain_id, pub_key.bytes()):
             return
         if not pub_key.verify_signature(
             self.extension_sign_bytes(chain_id), self.extension_signature

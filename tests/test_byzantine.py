@@ -1,23 +1,44 @@
-"""Byzantine behavior: an equivocating validator yields committed evidence.
+"""Byzantine behavior: equivocating validators yield committed evidence.
 
-The in-process analog of internal/consensus/byzantine_test.go: one of
-four validators double-signs prevotes (same height/round, conflicting
-block IDs). Honest peers detect the conflict in their vote sets
-(types/vote_set.go conflicting-vote tracking), turn it into
-DuplicateVoteEvidence (evidence pool reportConflictingVotes), gossip
-it, and a later proposer commits it into a block.
+The in-process analog of internal/consensus/byzantine_test.go and
+invalid_test.go:
+
+- an equivocating PREVOTER (double-signs conflicting prevotes) — honest
+  peers detect the conflict in their vote sets (types/vote_set.go
+  conflicting-vote tracking), turn it into DuplicateVoteEvidence
+  (evidence pool reportConflictingVotes), gossip it, and a later
+  proposer commits it into a block;
+- an equivocating PROPOSER (byzantine_test.go:41): signs TWO different
+  proposal blocks for the same height/round, sends each to a different
+  half of its peers, and double-signs its own precommits to match —
+  the network stays live and the double-sign lands on-chain as
+  DuplicateVoteEvidence;
+- malformed-vote injection (invalid_test.go): garbage signatures, bad
+  indices, absurd heights — dropped without loss of liveness.
 """
 
 import time
 
 import pytest
 
-from tendermint_tpu.types.block import BlockID, Vote
+from tendermint_tpu.consensus.reactor import (
+    DATA_CHANNEL,
+    VOTE_CHANNEL,
+    encode_block_part,
+    encode_proposal,
+    encode_vote,
+)
+from tendermint_tpu.p2p.router import Envelope
+from tendermint_tpu.types.block import BlockID, Proposal, Vote
 from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.part_set import PartSet
 
 from tests.test_node import fast_genesis, make_node, wait_for, four_privs  # noqa: F401
 from tendermint_tpu.p2p.transport import MemoryNetwork
-from tendermint_tpu.encoding.canonical import SIGNED_MSG_TYPE_PREVOTE
+from tendermint_tpu.encoding.canonical import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+)
 
 
 def _make_equivocator(node, chain_id):
@@ -46,6 +67,91 @@ def _make_equivocator(node, chain_id):
             orig(dup)
 
     reactor.broadcast_vote = byzantine_broadcast
+
+
+def _split_peers(reactor):
+    """Deterministic halves of the byzantine node's live peer set."""
+    peers = sorted(reactor._peers)
+    return peers[: len(peers) // 2], peers[len(peers) // 2 :]
+
+
+def _make_proposer_equivocator(node, chain_id):
+    """byzantine_test.go:41 - the byzantine PROPOSER signs two different
+    blocks for the same (height, round), sends block A to one half of
+    its peers and block B to the other, and double-signs its own
+    non-nil votes to match. Both signed artifacts are genuine — only
+    FilePV's double-sign guard is bypassed, exactly what a byzantine
+    signer would do."""
+    from tendermint_tpu.types.block import BLOCK_PART_SIZE_BYTES
+
+    reactor = node.consensus_reactor
+    cs = node.consensus
+    pv = node.priv_validator
+    alt = {}  # (height, round) -> alternative proposal (for block B)
+
+    orig_decide = cs.decide_proposal
+
+    def split_decide(height, round_):
+        orig_decide(height, round_)  # proposes + broadcasts block A
+        block_b = cs._create_proposal_block()
+        if block_b is None:
+            return
+        parts_b = PartSet.from_data(
+            block_b.to_proto_bytes(), BLOCK_PART_SIZE_BYTES
+        )
+        prop_b = Proposal(
+            height=height,
+            round=round_,
+            pol_round=-1,
+            block_id=BlockID(block_b.hash(), parts_b.header()),
+            timestamp=block_b.header.time,
+        )
+        prop_b.signature = pv.priv_key.sign(prop_b.sign_bytes(chain_id))
+        alt[(height, round_)] = prop_b
+        _, second_half = _split_peers(reactor)
+        for pid in second_half:
+            reactor.data_ch.send(
+                Envelope(DATA_CHANNEL, encode_proposal(prop_b), to_peer=pid)
+            )
+            for i in range(parts_b.total):
+                reactor.data_ch.send(
+                    Envelope(
+                        DATA_CHANNEL,
+                        encode_block_part(height, round_, parts_b.get_part(i)),
+                        to_peer=pid,
+                    )
+                )
+
+    cs.decide_proposal = split_decide
+
+    orig_bvote = reactor.broadcast_vote
+
+    def split_vote(vote: Vote) -> None:
+        prop_b = alt.get((vote.height, vote.round))
+        first_half, second_half = _split_peers(reactor)
+        if prop_b is None or vote.block_id.is_nil() or not second_half:
+            orig_bvote(vote)
+            return
+        dup = Vote(
+            type=vote.type,
+            height=vote.height,
+            round=vote.round,
+            block_id=prop_b.block_id,
+            timestamp=vote.timestamp,
+            validator_address=vote.validator_address,
+            validator_index=vote.validator_index,
+        )
+        dup.signature = pv.priv_key.sign(dup.sign_bytes(chain_id))
+        for pid in first_half:
+            reactor.vote_ch.send(
+                Envelope(VOTE_CHANNEL, encode_vote(vote), to_peer=pid)
+            )
+        for pid in second_half:
+            reactor.vote_ch.send(
+                Envelope(VOTE_CHANNEL, encode_vote(dup), to_peer=pid)
+            )
+
+    reactor.broadcast_vote = split_vote
 
 
 class TestByzantine:
@@ -89,6 +195,114 @@ class TestByzantine:
                 f"no DuplicateVoteEvidence committed; heights: "
                 f"{[n.height for n in nodes]}"
             )
+        finally:
+            for node in nodes:
+                node.stop()
+
+    def test_equivocating_proposer_gets_evidenced(self, tmp_path, four_privs):
+        """The byzantine node is the hub so its split reaches every honest
+        peer directly; its canonical votes relay through gossip, so the
+        conflicting pair meets in some honest vote set, becomes
+        DuplicateVoteEvidence, and is committed — while the network
+        keeps producing blocks (byzantine_test.go:41)."""
+        net = MemoryNetwork()
+        nodes = []
+        for i in range(4):
+            node, _ = make_node(tmp_path, f"node{i}", four_privs, index=i, net=net)
+            nodes.append(node)
+        for i, node in enumerate(nodes):
+            if i > 0:
+                node.config.persistent_peers = [
+                    f"{nodes[0].node_key.node_id}@node0"
+                ]
+        _make_proposer_equivocator(nodes[0], nodes[0].genesis.chain_id)
+        for node in nodes:
+            node.start()
+        try:
+            assert wait_for(
+                lambda: all(len(n.router.connected_peers()) >= 1 for n in nodes),
+                timeout=10,
+            ), "peers failed to connect"
+
+            byz_addr = four_privs[0].get_pub_key().address()
+
+            def committed_byz_evidence():
+                for n in nodes:
+                    for h in range(1, n.height + 1):
+                        blk = n.block_store.load_block(h)
+                        if blk is None:
+                            continue
+                        for ev in blk.evidence:
+                            if (
+                                isinstance(ev, DuplicateVoteEvidence)
+                                and ev.vote_a.validator_address == byz_addr
+                            ):
+                                return True
+                return False
+
+            assert wait_for(committed_byz_evidence, timeout=120), (
+                f"no DuplicateVoteEvidence for the proposer committed; "
+                f"heights: {[n.height for n in nodes]}"
+            )
+            # Liveness: the split did not halt the chain.
+            assert wait_for(
+                lambda: all(n.height >= 3 for n in nodes if n is not nodes[0]),
+                timeout=60,
+            ), f"liveness lost: {[n.height for n in nodes]}"
+        finally:
+            for node in nodes:
+                node.stop()
+
+    def test_invalid_vote_flood_preserves_liveness(self, tmp_path, four_privs):
+        """invalid_test.go: one node floods peers with malformed votes —
+        garbage signatures, out-of-range indices, absurd heights. Honest
+        nodes drop them all and keep committing."""
+        net = MemoryNetwork()
+        nodes = []
+        for i in range(4):
+            node, _ = make_node(tmp_path, f"node{i}", four_privs, index=i, net=net)
+            nodes.append(node)
+        for i, node in enumerate(nodes):
+            if i > 0:
+                node.config.persistent_peers = [
+                    f"{nodes[0].node_key.node_id}@node0"
+                ]
+        evil = nodes[1]
+        reactor = evil.consensus_reactor
+        orig = reactor.broadcast_vote
+
+        def flooding_broadcast(vote: Vote) -> None:
+            orig(vote)
+            base = dict(
+                type=vote.type,
+                height=vote.height,
+                round=vote.round,
+                block_id=vote.block_id,
+                timestamp=vote.timestamp,
+                validator_address=vote.validator_address,
+                validator_index=vote.validator_index,
+            )
+            garbage = [
+                Vote(**{**base, "signature": b"\x01" * 64}),
+                Vote(**{**base, "validator_index": 97,
+                        "signature": b"\x02" * 64}),
+                Vote(**{**base, "height": vote.height + 10_000,
+                        "signature": b"\x03" * 64}),
+            ]
+            for g in garbage:
+                reactor.vote_ch.broadcast(encode_vote(g))
+
+        reactor.broadcast_vote = flooding_broadcast
+        for node in nodes:
+            node.start()
+        try:
+            assert wait_for(
+                lambda: all(len(n.router.connected_peers()) >= 1 for n in nodes),
+                timeout=10,
+            ), "peers failed to connect"
+            assert wait_for(
+                lambda: all(n.height >= 3 for n in nodes), timeout=90
+            ), f"liveness lost under invalid-vote flood: {[n.height for n in nodes]}"
         finally:
             for node in nodes:
                 node.stop()

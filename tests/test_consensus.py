@@ -241,3 +241,311 @@ class TestPeerRobustness:
             assert wait_for_height([cs], 2, timeout=30)
         finally:
             cs.stop()
+
+
+# --- POL locking / unlocking (state_test.go locking sections) ---------------
+
+
+class CaptureB(Broadcaster):
+    """Records everything the subject validator broadcasts."""
+
+    def __init__(self):
+        self.proposals = []
+        self.parts = []
+        self.votes = []
+
+    def broadcast_proposal(self, proposal):
+        self.proposals.append(proposal)
+
+    def broadcast_block_part(self, height, round_, part):
+        self.parts.append((height, round_, part))
+
+    def broadcast_vote(self, vote):
+        self.votes.append(vote)
+
+
+def _wait(fn, timeout=15.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    return None
+
+
+def _vote_of(cap, type_, round_, height=1):
+    for v in cap.votes:
+        if v.type == type_ and v.round == round_ and v.height == height:
+            return v
+    return None
+
+
+class LockHarness:
+    """Reference common_test.go style driver: ONE real ConsensusState
+    (chosen to be the height-1 round-0 proposer) plus three scripted
+    validators whose votes are crafted and injected. Pins the POL
+    lock/unlock/relock rules of state.go defaultDoPrevote:1512 and
+    enterPrecommit:1682."""
+
+    def __init__(self, tmp_path, subject_is_proposer=True):
+        privs = [
+            FilePV.generate(
+                str(tmp_path / f"lk{i}.json"), str(tmp_path / f"ls{i}.json")
+            )
+            for i in range(4)
+        ]
+        probe, _, _ = build_validator(tmp_path, n_vals=4, index=0, privs=privs)
+        proposer_addr = probe.rs.validators.get_proposer().address
+        by_addr = {p.get_pub_key().address(): i for i, p in enumerate(privs)}
+        prop_idx = by_addr[proposer_addr]
+        if subject_is_proposer:
+            idx = prop_idx
+        else:
+            idx = next(i for i in range(4) if i != prop_idx)
+        if idx == 0:
+            self.cs = probe
+        else:
+            self.cs, _, _ = build_validator(
+                tmp_path, n_vals=4, index=idx, privs=privs
+            )
+        self.tmp_path = tmp_path
+        self.privs = privs
+        self.cap = CaptureB()
+        self.cs.broadcaster = self.cap
+        self.vset = self.cs.state.validators
+        self.index_of = {
+            v.address: i for i, v in enumerate(self.vset.validators)
+        }
+        self.priv_of_index = {
+            self.index_of[p.get_pub_key().address()]: p for p in privs
+        }
+        self.subject_index = self.index_of[
+            privs[idx].get_pub_key().address()
+        ]
+
+    def others(self):
+        return [i for i in range(4) if i != self.subject_index]
+
+    def make_vote(self, val_index, type_, round_, block_id, height=1):
+        from tendermint_tpu.types.block import Vote
+
+        pv = self.priv_of_index[val_index]
+        v = Vote(
+            type=type_,
+            height=height,
+            round=round_,
+            block_id=block_id,
+            timestamp=Timestamp.from_unix_ns(BASE_NS + 1000 + val_index),
+            validator_address=pv.get_pub_key().address(),
+            validator_index=val_index,
+        )
+        v.signature = pv.priv_key.sign(v.sign_bytes(CHAIN_ID))
+        return v
+
+    def inject_votes(self, type_, round_, block_id, n=None, height=1):
+        idxs = self.others() if n is None else self.others()[:n]
+        for i in idxs:
+            self.cs.add_vote_from_peer(
+                self.make_vote(i, type_, round_, block_id, height), f"peer{i}"
+            )
+
+    def proposal_block_id(self):
+        """BlockID of the subject's own round-0 proposal."""
+        prop = _wait(lambda: self.cap.proposals[0] if self.cap.proposals else None)
+        assert prop is not None, "subject never proposed"
+        return prop.block_id
+
+    def alternative_block(self, proposer_index_in_vset):
+        """A valid competing block built by the given validator's own
+        proposal machinery (different proposer + timestamp -> different
+        hash), plus its part set."""
+        from tendermint_tpu.types.block import BLOCK_PART_SIZE_BYTES
+        from tendermint_tpu.types.part_set import PartSet as PS
+
+        priv = self.priv_of_index[proposer_index_in_vset]
+        priv_pos = next(
+            i for i, p in enumerate(self.privs) if p is priv
+        )
+        shadow, _, _ = build_validator(
+            self.tmp_path, n_vals=4, index=priv_pos, privs=self.privs
+        )
+        block = shadow._create_proposal_block()
+        assert block is not None
+        parts = PS.from_data(block.to_proto_bytes(), BLOCK_PART_SIZE_BYTES)
+        return block, parts
+
+    def inject_proposal(self, proposer_index, block, parts, round_, pol_round=-1):
+        from tendermint_tpu.types.block import BlockID as BID, Proposal
+
+        priv = self.priv_of_index[proposer_index]
+        prop = Proposal(
+            height=1,
+            round=round_,
+            pol_round=pol_round,
+            block_id=BID(block.hash(), parts.header()),
+            timestamp=block.header.time,
+        )
+        prop.signature = priv.priv_key.sign(prop.sign_bytes(CHAIN_ID))
+        self.cs.add_proposal_from_peer(prop, "peerP")
+        for i in range(parts.total):
+            self.cs.add_block_part_from_peer(1, round_, parts.get_part(i), "peerP")
+
+
+class TestLocking:
+    def test_nil_prevote_on_propose_timeout(self, tmp_path):
+        """No proposal arrives: after the propose timeout the validator
+        prevotes nil (state_test.go TestStateFullRoundNil analog)."""
+        h = LockHarness(tmp_path, subject_is_proposer=False)
+        h.cs.start()
+        try:
+            pv = _wait(lambda: _vote_of(h.cap, 1, 0))  # SIGNED_MSG_TYPE_PREVOTE
+            assert pv is not None, "no prevote broadcast"
+            assert pv.block_id.is_nil(), "must prevote nil without a proposal"
+        finally:
+            h.cs.stop()
+
+    def test_lock_then_nil_prevote_on_new_block_without_pol(self, tmp_path):
+        """Round 0: subject proposes A, sees a polka for A, precommits A
+        and locks. Round 1: a valid competing block B arrives with NO
+        POL — the locked validator must prevote nil, not B
+        (state_test.go TestStateLock_NoPOL / POLRelock family)."""
+        from tendermint_tpu.encoding.canonical import (
+            SIGNED_MSG_TYPE_PRECOMMIT as PC,
+            SIGNED_MSG_TYPE_PREVOTE as PV,
+        )
+        from tendermint_tpu.types.block import BlockID as BID
+
+        h = LockHarness(tmp_path, subject_is_proposer=True)
+        h.cs.start()
+        try:
+            a_id = h.proposal_block_id()
+            # polka for A in round 0 -> subject precommits A and locks
+            h.inject_votes(PV, 0, a_id, n=2)
+            pc0 = _wait(lambda: _vote_of(h.cap, PC, 0))
+            assert pc0 is not None and pc0.block_id.hash == a_id.hash
+            assert h.cs.rs.locked_round == 0
+
+            # nil precommits from everyone else -> round 1
+            h.inject_votes(PC, 0, BID())
+            assert _wait(lambda: h.cs.rs.round == 1, timeout=20), (
+                f"stuck in round {h.cs.rs.round}"
+            )
+
+            # competing valid block B from the round-1 proposer, no POL
+            r1_proposer = h.index_of[
+                h.cs.rs.validators.get_proposer().address
+            ]
+            assert r1_proposer != h.subject_index, "rotation must move on"
+            block_b, parts_b = h.alternative_block(r1_proposer)
+            assert block_b.hash() != a_id.hash
+            h.inject_proposal(r1_proposer, block_b, parts_b, round_=1)
+
+            pv1 = _wait(lambda: _vote_of(h.cap, PV, 1), timeout=20)
+            assert pv1 is not None, "no round-1 prevote"
+            assert pv1.block_id.is_nil(), (
+                "locked validator prevoted a different block without a POL"
+            )
+            # it DID consider B (not a timeout artifact)
+            assert h.cs.rs.proposal_block is not None
+            assert h.cs.rs.proposal_block.hash() == block_b.hash()
+            assert h.cs.rs.locked_block.hash() == a_id.hash
+
+            # now a round-1 polka for B arrives: the subject must RELOCK
+            # to B and precommit it (enterPrecommit:1682 relock rule)
+            b_id = BID(block_b.hash(), parts_b.header())
+            h.inject_votes(PV, 1, b_id)
+            pc1 = _wait(lambda: _vote_of(h.cap, PC, 1), timeout=20)
+            assert pc1 is not None, "no round-1 precommit"
+            assert pc1.block_id.hash == block_b.hash(), "must relock on new POL"
+            assert h.cs.rs.locked_round == 1
+            assert h.cs.rs.locked_block.hash() == block_b.hash()
+        finally:
+            h.cs.stop()
+
+    def test_prevote_locked_block_when_reproposed_with_pol(self, tmp_path):
+        """Round 1 re-proposes the LOCKED block A with pol_round=0: the
+        validator prevotes A again (the pol_round acceptance path of
+        defaultDoPrevote:1512)."""
+        from tendermint_tpu.encoding.canonical import (
+            SIGNED_MSG_TYPE_PRECOMMIT as PC,
+            SIGNED_MSG_TYPE_PREVOTE as PV,
+        )
+        from tendermint_tpu.types.block import BlockID as BID, Proposal
+
+        h = LockHarness(tmp_path, subject_is_proposer=True)
+        h.cs.start()
+        try:
+            a_id = h.proposal_block_id()
+            a_parts = _wait(
+                lambda: h.cap.parts if h.cap.parts else None
+            )
+            h.inject_votes(PV, 0, a_id, n=2)
+            assert _wait(lambda: h.cs.rs.locked_round == 0, timeout=20)
+            locked_block = h.cs.rs.locked_block
+            h.inject_votes(PC, 0, BID())
+            assert _wait(lambda: h.cs.rs.round == 1, timeout=20)
+
+            r1_proposer = h.index_of[
+                h.cs.rs.validators.get_proposer().address
+            ]
+            priv = h.priv_of_index[r1_proposer]
+            prop = Proposal(
+                height=1,
+                round=1,
+                pol_round=0,
+                block_id=a_id,
+                timestamp=locked_block.header.time,
+            )
+            prop.signature = priv.priv_key.sign(prop.sign_bytes(CHAIN_ID))
+            h.cs.add_proposal_from_peer(prop, "peerP")
+            for _, _, part in a_parts:
+                h.cs.add_block_part_from_peer(1, 1, part, "peerP")
+
+            pv1 = _wait(lambda: _vote_of(h.cap, PV, 1), timeout=20)
+            assert pv1 is not None, "no round-1 prevote"
+            assert pv1.block_id.hash == a_id.hash, (
+                "validator must prevote its locked block when re-proposed "
+                "with a valid POL round"
+            )
+        finally:
+            h.cs.stop()
+
+    def test_invalid_injected_votes_do_not_corrupt_lock_state(self, tmp_path):
+        """Garbage votes (bad signature / bogus index) around a genuine
+        polka neither stall the round nor alter lock bookkeeping
+        (invalid_test.go vote-injection analog at the state layer)."""
+        from tendermint_tpu.encoding.canonical import (
+            SIGNED_MSG_TYPE_PRECOMMIT as PC,
+            SIGNED_MSG_TYPE_PREVOTE as PV,
+        )
+        from tendermint_tpu.types.block import Vote
+
+        h = LockHarness(tmp_path, subject_is_proposer=True)
+        h.cs.start()
+        try:
+            a_id = h.proposal_block_id()
+            good = h.make_vote(h.others()[0], PV, 0, a_id)
+            bad_sig = h.make_vote(h.others()[1], PV, 0, a_id)
+            bad_sig.signature = b"\x01" * 64
+            bad_idx = Vote(
+                type=PV, height=1, round=0, block_id=a_id,
+                timestamp=Timestamp.from_unix_ns(BASE_NS),
+                validator_address=b"\x05" * 20, validator_index=55,
+                signature=b"\x02" * 64,
+            )
+            h.cs.add_vote_from_peer(bad_sig, "evil")
+            h.cs.add_vote_from_peer(bad_idx, "evil")
+            h.cs.add_vote_from_peer(good, "peer")
+            # only the good vote + subject's own count: no polka yet
+            time.sleep(0.3)
+            assert h.cs.rs.locked_round == -1
+            # second genuine prevote completes the polka -> lock + precommit A
+            h.cs.add_vote_from_peer(
+                h.make_vote(h.others()[1], PV, 0, a_id), "peer"
+            )
+            pc0 = _wait(lambda: _vote_of(h.cap, PC, 0), timeout=20)
+            assert pc0 is not None and pc0.block_id.hash == a_id.hash
+            assert h.cs.rs.locked_round == 0
+        finally:
+            h.cs.stop()
