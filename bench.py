@@ -36,6 +36,11 @@ BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
 ROUNDS = int(os.environ.get("BENCH_ROUNDS", "5"))
 COMMIT_VALS = int(os.environ.get("BENCH_COMMIT_VALS", "10000"))
 CHILD_TIMEOUT = float(os.environ.get("BENCH_TIMEOUT", "1500"))
+# BASELINE configs 3 & 4 (light-client chain walk, pipelined blocksync)
+LIGHT_HEADERS = int(os.environ.get("BENCH_LIGHT_HEADERS", "16"))
+LIGHT_VALS = int(os.environ.get("BENCH_LIGHT_VALS", "1000"))
+SYNC_BLOCKS = int(os.environ.get("BENCH_SYNC_BLOCKS", "32"))
+SYNC_VALS = int(os.environ.get("BENCH_SYNC_VALS", "500"))
 
 
 def _log_probe(line: str) -> None:
@@ -138,9 +143,7 @@ def _stage_breakdown(pks, msgs, sigs):
     }
 
 
-def _verify_commit_p50(n_vals: int, iters: int = 7):
-    """p50 end-to-end VerifyCommit latency at n_vals validators
-    (types/validation.go:27-54 semantics; BASELINE.md tracked metric)."""
+def _load_helpers():
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
@@ -148,6 +151,105 @@ def _verify_commit_p50(n_vals: int, iters: int = 7):
     )
     helpers = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(helpers)
+    return helpers
+
+
+def _build_header_chain(n_heights, n_vals):
+    """Signed-header chain with a constant validator set (the shape of
+    light/client_benchmark_test.go's fixture)."""
+    import hashlib
+
+    from tendermint_tpu.encoding.canonical import Timestamp
+    from tendermint_tpu.types import (
+        BlockID,
+        Consensus,
+        Header,
+        PartSetHeader,
+        SignedHeader,
+    )
+
+    helpers = _load_helpers()
+    base_ns = 1_700_000_000_000_000_000
+    privs, vset = helpers.make_validators(n_vals)
+    chain = []
+    last_bid = BlockID()
+    for h in range(1, n_heights + 1):
+        header = Header(
+            version=Consensus(block=11),
+            chain_id=helpers.CHAIN_ID,
+            height=h,
+            time=Timestamp.from_unix_ns(base_ns + h * 1_000_000_000),
+            last_block_id=last_bid,
+            last_commit_hash=hashlib.sha256(b"lc%d" % h).digest(),
+            data_hash=hashlib.sha256(b"d%d" % h).digest(),
+            validators_hash=vset.hash(),
+            next_validators_hash=vset.hash(),
+            consensus_hash=hashlib.sha256(b"cp").digest(),
+            app_hash=hashlib.sha256(b"app%d" % h).digest(),
+            last_results_hash=b"",
+            evidence_hash=b"",
+            proposer_address=vset.validators[0].address,
+        )
+        bid = BlockID(
+            header.hash(), PartSetHeader(1, hashlib.sha256(b"p%d" % h).digest())
+        )
+        commit = helpers.make_commit(
+            bid, h, 0, vset, privs, time_ns=base_ns + h * 1_000_000_000
+        )
+        chain.append(SignedHeader(header=header, commit=commit))
+        last_bid = bid
+    return chain, vset, helpers.CHAIN_ID
+
+
+def _light_client_headers_per_s(n_headers, n_vals):
+    """BASELINE config 3: light-client sequential chain walk at n_vals
+    validators — each step is a VerifyAdjacent (valhash link + 2/3
+    commit verify on the device batch path). Match:
+    light/client_benchmark_test.go, light/verifier.go:106-152."""
+    from tendermint_tpu.encoding.canonical import Timestamp
+    from tendermint_tpu.light.verifier import verify_adjacent
+
+    chain, vset, _ = _build_header_chain(n_headers, n_vals)
+    now = Timestamp.from_unix_ns(
+        1_700_000_000_000_000_000 + (n_headers + 2) * 1_000_000_000
+    )
+
+    def walk():
+        for i in range(1, len(chain)):
+            verify_adjacent(chain[i - 1], chain[i], vset, 86400.0, now, 10.0)
+
+    walk()  # warmup/compile
+    t0 = time.perf_counter()
+    walk()
+    dt = time.perf_counter() - t0
+    return round((len(chain) - 1) / dt, 2)
+
+
+def _blocksync_blocks_per_s(n_blocks, n_vals):
+    """BASELINE config 4: a blocksync catch-up window's commits flattened
+    into one pipelined device batch. Match:
+    internal/blocksync/reactor.go:538-650 (serial VerifyCommitLight in
+    the reference), parallel/pipeline.py here."""
+    from tendermint_tpu.parallel.pipeline import CommitTask, verify_commits_pipelined
+
+    chain, vset, chain_id = _build_header_chain(n_blocks, n_vals)
+    tasks = [
+        CommitTask(chain_id, vset, sh.commit.block_id, sh.header.height, sh.commit)
+        for sh in chain
+    ]
+    verdicts = verify_commits_pipelined(tasks)  # warmup/compile
+    assert all(v.ok for v in verdicts), "benchmark commits must verify"
+    t0 = time.perf_counter()
+    verdicts = verify_commits_pipelined(tasks)
+    dt = time.perf_counter() - t0
+    assert all(v.ok for v in verdicts)
+    return round(n_blocks / dt, 2)
+
+
+def _verify_commit_p50(n_vals: int, iters: int = 7):
+    """p50 end-to-end VerifyCommit latency at n_vals validators
+    (types/validation.go:27-54 semantics; BASELINE.md tracked metric)."""
+    helpers = _load_helpers()
 
     from tendermint_tpu.types import validation
 
@@ -193,8 +295,12 @@ def child_main() -> None:
 
     stages = _stage_breakdown(pks, msgs, sigs)
     commit_p50 = None
+    light_hps = sync_bps = None
     if os.environ.get("BENCH_SKIP_COMMIT") != "1":
         commit_p50 = _verify_commit_p50(COMMIT_VALS)
+    if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
+        light_hps = _light_client_headers_per_s(LIGHT_HEADERS, LIGHT_VALS)
+        sync_bps = _blocksync_blocks_per_s(SYNC_BLOCKS, SYNC_VALS)
 
     print(
         json.dumps(
@@ -207,6 +313,8 @@ def child_main() -> None:
                 "impl": stages.pop("impl"),
                 "stages_ms": stages,
                 f"verify_commit_p50_ms_v{COMMIT_VALS}": commit_p50,
+                f"light_client_headers_per_s_v{LIGHT_VALS}": light_hps,
+                f"blocksync_blocks_per_s_v{SYNC_VALS}": sync_bps,
             }
         ),
         flush=True,

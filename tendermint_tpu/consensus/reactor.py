@@ -170,16 +170,25 @@ class VotePreverifier:
     def _warmup(self) -> None:
         """Compile/warm the batch engine off the hot path; flip _warm
         only once a known-good verify round-trips. Also the re-warm
-        probe after a cold flip: only one attempt runs at a time."""
-        from tendermint_tpu.crypto.batch import get_shared_scheduler
+        probe after a cold flip: only one attempt runs at a time.
+
+        The probe must take the same path a real flood takes: the
+        scheduler's flush routes small batches (< DEVICE_THRESHOLD) to
+        the host, so a single-entry probe would "warm" without ever
+        compiling the device kernel. Probe at the threshold size so the
+        device kernel is genuinely compiled before _warm flips."""
+        from tendermint_tpu.crypto.batch import DEVICE_THRESHOLD, get_shared_scheduler
         from tendermint_tpu.ops.ed25519_batch import _PAD_MSG, _PAD_PK, _PAD_SIG
 
         if not self._rewarming.acquire(blocking=False):
             return
         try:
-            if get_shared_scheduler().verify(
-                _PAD_PK, _PAD_MSG, _PAD_SIG, timeout=120.0
-            ):
+            sched = get_shared_scheduler()
+            handles = [
+                sched.submit(_PAD_PK, _PAD_MSG, _PAD_SIG)
+                for _ in range(DEVICE_THRESHOLD)
+            ]
+            if all(sched.wait(h, timeout=120.0) for h in handles):
                 self._deadline_misses = 0
                 self._warm.set()
         except Exception:
@@ -197,13 +206,14 @@ class VotePreverifier:
         if self._thread is not None:
             self._thread.join(timeout=2)
             self._thread = None
-        # drain: forward stragglers unmarked so no vote is lost
+        # Discard stragglers: the state loop is already stopped at node
+        # shutdown (its queue may be full — forwarding would block
+        # forever), and undelivered votes are simply re-gossiped.
         while True:
             try:
-                item = self._q.get_nowait()
+                self._q.get_nowait()
             except queue.Empty:
                 break
-            self.cs.add_vote_from_peer(item[0], item[1])
 
     def _resolve_pub_key(self, vote: Vote):
         """Expected signer for this vote, or None when not resolvable

@@ -18,6 +18,13 @@ from tendermint_tpu.crypto.keys import (
 )
 
 
+# Host/device crossover: below this many signatures a device launch
+# costs more than it saves, so batches stay on the host (the analog of
+# the reference's batchVerifyThreshold, types/validation.go:12-16).
+# Shared by Ed25519BatchVerifier and the process-wide scheduler.
+DEVICE_THRESHOLD = 16
+
+
 class BatchVerifier:
     """crypto.BatchVerifier contract (crypto/crypto.go:58-76): Add entries,
     then Verify once; returns (all_valid, per-entry validity)."""
@@ -42,7 +49,11 @@ class Ed25519BatchVerifier(BatchVerifier):
     types/validation.go:12-16).
     """
 
-    def __init__(self, device_threshold: int = 16, use_device: Optional[bool] = None):
+    def __init__(
+        self,
+        device_threshold: int = DEVICE_THRESHOLD,
+        use_device: Optional[bool] = None,
+    ):
         self._pks: List[bytes] = []
         self._msgs: List[bytes] = []
         self._sigs: List[bytes] = []
@@ -127,7 +138,7 @@ def get_shared_scheduler():
                 # the device threshold a launch costs more than it saves
                 # — at steady-state vote rates flushes are 1-2 entries
                 # and must stay on the host; only floods hit the device.
-                if len(pks) < 16:
+                if len(pks) < DEVICE_THRESHOLD:
                     from tendermint_tpu.crypto.ed25519_ref import verify_zip215
 
                     return [
