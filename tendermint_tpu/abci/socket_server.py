@@ -107,6 +107,10 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--app", default="kvstore", choices=["kvstore", "noop"])
     p.add_argument("--db", default="", help="persist kvstore state to this filedb path")
     p.add_argument("--snapshot-interval", type=int, default=0)
+    p.add_argument(
+        "--transport", default="socket", choices=["socket", "grpc"],
+        help="wire transport (abci/server: socket_server.go / grpc_server.go)",
+    )
     args = p.parse_args(argv)
 
     if args.app == "kvstore":
@@ -124,7 +128,12 @@ def main(argv: Optional[list] = None) -> None:
         app = abci.BaseApplication()
 
     host, _, port = args.addr.rpartition(":")
-    server = SocketServer(app, host or "127.0.0.1", int(port))
+    if args.transport == "grpc":
+        from tendermint_tpu.abci.grpc_server import GrpcABCIServer
+
+        server = GrpcABCIServer(app, host or "127.0.0.1", int(port))
+    else:
+        server = SocketServer(app, host or "127.0.0.1", int(port))
     print(f"abci server listening on {server.address[0]}:{server.address[1]}", flush=True)
     server.serve_forever()
 

@@ -105,8 +105,14 @@ def _make_app_client(cfg: Config):
 
         host, _, port = spec[6:].rpartition(":")
         return SocketClient(host or "127.0.0.1", int(port))
+    if spec.startswith("grpc://"):
+        from tendermint_tpu.abci.grpc_client import GrpcClient
+
+        host, _, port = spec[7:].rpartition(":")
+        return GrpcClient(host or "127.0.0.1", int(port))
     raise ValueError(
-        f"unknown proxy_app {spec!r} (kvstore | persistent_kvstore | tcp://host:port)"
+        f"unknown proxy_app {spec!r} "
+        "(kvstore | persistent_kvstore | tcp://host:port | grpc://host:port)"
     )
 
 

@@ -106,6 +106,32 @@ class Node:
                 node_key = NodeKey.generate()
         self.node_key = node_key
         self._signer_endpoint = None
+        self._owned_signer = None  # gRPC signer client the node must close
+        if priv_validator is None and config.priv_validator_laddr.startswith(
+            "grpc://"
+        ):
+            # gRPC remote signer (privval/grpc/client.go): the node DIALS
+            # the signer's server — inverse of the socket flavor below.
+            from tendermint_tpu.privval.grpc import GrpcSignerClient
+
+            host, _, port = config.priv_validator_laddr[7:].rpartition(":")
+            priv_validator = GrpcSignerClient(
+                host or "127.0.0.1", int(port), genesis.chain_id
+            )
+            # Same grace the socket flavor gives wait_for_connection: the
+            # signer may come up moments after the node does.
+            import time as _time
+
+            deadline = _time.monotonic() + config.signer_connect_timeout
+            while True:
+                try:
+                    priv_validator.get_pub_key()
+                    break
+                except (ConnectionError, OSError):
+                    if _time.monotonic() >= deadline:
+                        raise
+                    _time.sleep(0.5)
+            self._owned_signer = priv_validator
         if priv_validator is None and config.priv_validator_laddr:
             # Remote signer (node/node.go:186 createPrivval → signer
             # listener): listen here, wait for the signer to dial in.
@@ -500,6 +526,11 @@ class Node:
         if self._signer_endpoint is not None:
             try:
                 self._signer_endpoint.close()
+            except Exception:
+                pass
+        if self._owned_signer is not None:
+            try:
+                self._owned_signer.close()
             except Exception:
                 pass
         for db in getattr(self, "_dbs", []):

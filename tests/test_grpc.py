@@ -1,0 +1,401 @@
+"""The in-repo gRPC stack (libs/grpc.py) and its two consumers: the
+ABCI gRPC transport (abci/grpc_client.py, abci/grpc_server.py — parity
+with the socket transport; reference abci/client/grpc_client.go:184,
+abci/server/grpc_server.go:83) and the gRPC remote signer
+(privval/grpc.py; reference privval/grpc/).
+"""
+
+import threading
+
+import pytest
+
+from tendermint_tpu.libs.grpc import (
+    GRPC_INTERNAL,
+    GRPC_UNIMPLEMENTED,
+    GrpcChannel,
+    GrpcError,
+    GrpcServer,
+    HpackDecoder,
+    hpack_encode,
+)
+
+
+# --- HPACK ------------------------------------------------------------------
+
+
+def test_hpack_roundtrip_literals():
+    headers = [
+        (":method", "POST"),
+        (":path", "/tendermint.abci.ABCIApplication/Info"),
+        ("content-type", "application/grpc"),
+        ("te", "trailers"),
+        ("x-custom", "v" * 300),  # multi-byte length integer
+    ]
+    dec = HpackDecoder()
+    assert dec.decode(hpack_encode(headers)) == headers
+
+
+def test_hpack_decodes_indexed_and_incremental():
+    # 0x82 = indexed static 2 (":method: GET"); then a literal with
+    # incremental indexing (0x40) inserting into the dynamic table;
+    # then 0xBE = dynamic index 62 (the entry just inserted).
+    block = bytes([0x82])
+    block += bytes([0x40, 0x05]) + b"x-abc" + bytes([0x03]) + b"yes"
+    block += bytes([0xBE])
+    dec = HpackDecoder()
+    assert dec.decode(block) == [
+        (":method", "GET"),
+        ("x-abc", "yes"),
+        ("x-abc", "yes"),
+    ]
+
+
+def test_hpack_rejects_huffman():
+    dec = HpackDecoder()
+    # literal, new name, huffman bit set on the name string
+    block = bytes([0x00, 0x81, 0xFF, 0x00])
+    from tendermint_tpu.libs.grpc import H2ProtocolError
+
+    with pytest.raises(H2ProtocolError):
+        dec.decode(block)
+
+
+# --- unary transport --------------------------------------------------------
+
+
+@pytest.fixture()
+def echo_server():
+    def echo(payload: bytes) -> bytes:
+        return payload
+
+    def boom(payload: bytes) -> bytes:
+        raise RuntimeError("kaput")
+
+    srv = GrpcServer({"/t.Svc/Echo": echo, "/t.Svc/Boom": boom})
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_unary_roundtrip_and_errors(echo_server):
+    host, port = echo_server.address
+    chan = GrpcChannel(host, port)
+    try:
+        assert chan.unary("/t.Svc/Echo", b"hello") == b"hello"
+        assert chan.unary("/t.Svc/Echo", b"") == b""
+        with pytest.raises(GrpcError) as ei:
+            chan.unary("/t.Svc/Boom", b"x")
+        assert ei.value.status == GRPC_INTERNAL
+        with pytest.raises(GrpcError) as ei:
+            chan.unary("/t.Svc/Nope", b"x")
+        assert ei.value.status == GRPC_UNIMPLEMENTED
+        # connection survives error responses
+        assert chan.unary("/t.Svc/Echo", b"still alive") == b"still alive"
+    finally:
+        chan.close()
+
+
+def test_unary_large_payload_flow_control(echo_server):
+    """>64KB in both directions: exercises DATA chunking to MAX_FRAME
+    and the connection-window replenishment."""
+    host, port = echo_server.address
+    chan = GrpcChannel(host, port)
+    try:
+        big = bytes(range(256)) * 1024  # 256 KB
+        assert chan.unary("/t.Svc/Echo", big) == big
+    finally:
+        chan.close()
+
+
+def test_many_sequential_calls_one_connection(echo_server):
+    host, port = echo_server.address
+    chan = GrpcChannel(host, port)
+    try:
+        for i in range(50):
+            msg = b"call %d" % i
+            assert chan.unary("/t.Svc/Echo", msg) == msg
+    finally:
+        chan.close()
+
+
+# --- ABCI transport parity --------------------------------------------------
+
+
+@pytest.fixture()
+def abci_pair():
+    from tendermint_tpu.abci.grpc_client import GrpcClient
+    from tendermint_tpu.abci.grpc_server import GrpcABCIServer
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+
+    app = KVStoreApplication()
+    srv = GrpcABCIServer(app)
+    srv.start()
+    host, port = srv.address
+    client = GrpcClient(host, port)
+    client.start()
+    yield client, app
+    client.stop()
+    srv.stop()
+
+
+def test_abci_grpc_socket_parity(abci_pair):
+    """The gRPC transport must return byte-identical results to driving
+    the same app locally (the socket-parity criterion)."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.client import LocalClient
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+
+    client, _ = abci_pair
+    local = LocalClient(KVStoreApplication())
+    local.start()
+
+    assert client.echo("ping") == "ping"
+    client.flush()
+
+    for c in (client, local):
+        c.init_chain(abci.RequestInitChain(chain_id="grpc-chain", initial_height=1))
+
+    tx = b"k1=v1"
+    for c in (client, local):
+        r = c.check_tx(abci.RequestCheckTx(tx=tx))
+        assert r.code == 0
+        fr = c.finalize_block(
+            abci.RequestFinalizeBlock(txs=[tx], height=1)
+        )
+        assert fr.tx_results[0].code == 0
+    g_hash = client.commit()
+    l_hash = local.commit()
+    # same app type, same txs -> same app state
+    gq = client.query(abci.RequestQuery(path="/key", data=b"k1"))
+    lq = local.query(abci.RequestQuery(path="/key", data=b"k1"))
+    assert gq.value == lq.value == b"v1"
+
+
+def test_abci_grpc_app_error_surfaces(abci_pair):
+    from tendermint_tpu.abci import types as abci
+
+    client, app = abci_pair
+
+    def broken(req):
+        raise ValueError("app exploded")
+
+    app.query = broken
+    with pytest.raises(RuntimeError, match="app exploded"):
+        client.query(abci.RequestQuery(path="/key", data=b"k"))
+
+
+# --- gRPC remote signer -----------------------------------------------------
+
+
+@pytest.fixture()
+def signer_pair(tmp_path):
+    from tendermint_tpu.privval import FilePV
+    from tendermint_tpu.privval.grpc import GrpcSignerClient, GrpcSignerServer
+
+    pv = FilePV.generate(
+        str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    )
+    srv = GrpcSignerServer(pv, "grpc-chain")
+    srv.start()
+    host, port = srv.address
+    client = GrpcSignerClient(host, port, "grpc-chain")
+    yield client, pv
+    client.close()
+    srv.stop()
+
+
+def test_signer_pubkey_and_vote_roundtrip(signer_pair):
+    from tendermint_tpu.encoding.canonical import (
+        SIGNED_MSG_TYPE_PREVOTE,
+        Timestamp,
+    )
+    from tendermint_tpu.types.block import Vote
+
+    client, pv = signer_pair
+    assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+
+    vote = Vote(
+        type=SIGNED_MSG_TYPE_PREVOTE,
+        height=7,
+        round=0,
+        timestamp=Timestamp.from_unix_ns(1_700_000_000_000_000_000),
+        validator_address=pv.get_pub_key().address(),
+        validator_index=0,
+    )
+    client.sign_vote("grpc-chain", vote)
+    assert vote.signature
+    vote.verify("grpc-chain", pv.get_pub_key())
+
+
+def test_signer_double_sign_refused_over_grpc(signer_pair):
+    """FilePV's HRS guard must travel across the transport: a
+    conflicting vote at the same HRS is refused, not signed."""
+    import hashlib
+
+    from tendermint_tpu.encoding.canonical import (
+        SIGNED_MSG_TYPE_PREVOTE,
+        Timestamp,
+    )
+    from tendermint_tpu.privval.remote import RemoteSignerError
+    from tendermint_tpu.types.block import BlockID, PartSetHeader, Vote
+
+    client, pv = signer_pair
+
+    def vote_for(salt):
+        h = hashlib.sha256(salt).digest()
+        return Vote(
+            type=SIGNED_MSG_TYPE_PREVOTE,
+            height=9,
+            round=0,
+            block_id=BlockID(h, PartSetHeader(1, h)),
+            timestamp=Timestamp.from_unix_ns(1_700_000_000_000_000_000),
+            validator_address=pv.get_pub_key().address(),
+            validator_index=0,
+        )
+
+    client.sign_vote("grpc-chain", vote_for(b"block-a"))
+    with pytest.raises(RemoteSignerError):
+        client.sign_vote("grpc-chain", vote_for(b"block-b"))
+
+
+def test_signer_chain_id_mismatch(signer_pair):
+    from tendermint_tpu.privval.grpc import GrpcSignerClient
+    from tendermint_tpu.privval.remote import RemoteSignerError
+
+    client, _ = signer_pair
+    host, port = client._chan._addr
+    wrong = GrpcSignerClient(host, port, "other-chain")
+    try:
+        with pytest.raises(RemoteSignerError, match="chain id"):
+            wrong.get_pub_key()
+    finally:
+        wrong.close()
+
+
+# --- config selection -------------------------------------------------------
+
+
+def test_proxy_app_grpc_selected(tmp_path, monkeypatch):
+    from tendermint_tpu.abci.grpc_client import GrpcClient
+    from tendermint_tpu.cli import _make_app_client
+    from tendermint_tpu.config import Config
+
+    cfg = Config()
+    cfg.base.proxy_app = "grpc://127.0.0.1:29999"
+    client = _make_app_client(cfg)
+    assert isinstance(client, GrpcClient)
+
+
+# --- full node over both gRPC transports ------------------------------------
+
+
+def test_node_runs_with_grpc_app_and_grpc_signer(tmp_path):
+    """A validator whose ABCI app lives behind the gRPC transport AND
+    whose key lives in a gRPC remote signer commits blocks — the
+    end-to-end wiring of proxy_app="grpc://..." and
+    priv_validator_laddr="grpc://..." (node/node.go createPrivval +
+    internal/proxy ClientFactory, gRPC flavors)."""
+    import time
+
+    from tendermint_tpu.abci.grpc_client import GrpcClient
+    from tendermint_tpu.abci.grpc_server import GrpcABCIServer
+    from tendermint_tpu.abci.kvstore import KVStoreApplication
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval import FilePV
+    from tendermint_tpu.privval.grpc import GrpcSignerServer
+
+    from tests.test_node import fast_genesis
+
+    pv = FilePV.generate(
+        str(tmp_path / "gk.json"), str(tmp_path / "gs.json")
+    )
+    genesis = fast_genesis([pv])
+
+    signer_srv = GrpcSignerServer(pv, genesis.chain_id)
+    signer_srv.start()
+    abci_srv = GrpcABCIServer(KVStoreApplication())
+    abci_srv.start()
+
+    app_client = GrpcClient(*abci_srv.address)
+    # Build the node the way cli._build_node does, but in-process.
+    from tendermint_tpu.node.node import NodeConfig
+
+    cfg = NodeConfig(
+        chain_id=genesis.chain_id,
+        listen_addr="127.0.0.1:0",
+        wal_enabled=False,
+        moniker="grpc-node",
+        priv_validator_laddr="grpc://%s:%d" % signer_srv.address,
+    )
+    node = Node(cfg, genesis, app_client, priv_validator=None)
+    node.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and node.height < 2:
+            time.sleep(0.05)
+        assert node.height >= 2, f"stuck at height {node.height}"
+    finally:
+        node.stop()
+        abci_srv.stop()
+        signer_srv.stop()
+
+
+# --- flow-control accounting ------------------------------------------------
+
+
+def test_settings_initial_window_applies_to_open_streams():
+    """RFC 9113 6.9.2: an INITIAL_WINDOW_SIZE change adjusts every open
+    stream's send window by the delta."""
+    import socket as socketlib
+    import struct
+
+    from tendermint_tpu.libs.grpc import (
+        SETTINGS_INITIAL_WINDOW_SIZE,
+        _ConnState,
+    )
+
+    a, b = socketlib.socketpair()
+    try:
+        conn = _ConnState(a)
+        conn.open_stream(1)
+        assert conn.stream_send[1] == 65535
+        conn._apply_settings(
+            struct.pack("!HI", SETTINGS_INITIAL_WINDOW_SIZE, 100_000)
+        )
+        assert conn.peer_initial_window == 100_000
+        assert conn.stream_send[1] == 65535 + (100_000 - 65535)
+        conn._apply_settings(
+            struct.pack("!HI", SETTINGS_INITIAL_WINDOW_SIZE, 50_000)
+        )
+        assert conn.stream_send[1] == 50_000
+    finally:
+        a.close()
+        b.close()
+
+
+def test_window_update_credits_named_stream_only():
+    import socket as socketlib
+
+    from tendermint_tpu.libs.grpc import (
+        FRAME_WINDOW_UPDATE,
+        _ConnState,
+        write_frame,
+    )
+
+    a, b = socketlib.socketpair()
+    try:
+        conn = _ConnState(a)
+        conn.open_stream(3)
+        base_conn = conn.send_window
+        base_stream = conn.stream_send[3]
+        write_frame(b, FRAME_WINDOW_UPDATE, 0, 3, (500).to_bytes(4, "big"))
+        conn.pump_once()
+        assert conn.stream_send[3] == base_stream + 500
+        assert conn.send_window == base_conn  # connection window untouched
+        write_frame(b, FRAME_WINDOW_UPDATE, 0, 0, (700).to_bytes(4, "big"))
+        conn.pump_once()
+        assert conn.send_window == base_conn + 700
+        assert conn.stream_send[3] == base_stream + 500
+    finally:
+        a.close()
+        b.close()
