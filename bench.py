@@ -246,14 +246,32 @@ def _blocksync_blocks_per_s(n_blocks, n_vals):
     return round(n_blocks / dt, 2)
 
 
+def _mixed_key_factory(i: int):
+    """Alternating ed25519 / sr25519 keys (BASELINE config 5 mix);
+    verification sub-batches per key type (crypto/batch
+    MultiBatchVerifier -> ops/ed25519_batch + ops/sr25519_batch)."""
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+    from tendermint_tpu.crypto.sr25519 import Sr25519PrivKey
+
+    if i % 2 == 0:
+        return Ed25519PrivKey.from_seed(i.to_bytes(32, "big"))
+    return Sr25519PrivKey.from_secret(b"bench-sr" + i.to_bytes(4, "big"))
+
+
 def _verify_commit_p50(n_vals: int, iters: int = 7):
     """p50 end-to-end VerifyCommit latency at n_vals validators
-    (types/validation.go:27-54 semantics; BASELINE.md tracked metric)."""
+    (types/validation.go:27-54 semantics; BASELINE.md tracked metric).
+    BENCH_COMMIT_MIX=mixed makes the set half ed25519 / half sr25519."""
     helpers = _load_helpers()
 
     from tendermint_tpu.types import validation
 
-    privs, vset = helpers.make_validators(n_vals)
+    if os.environ.get("BENCH_COMMIT_MIX", "ed") == "mixed":
+        privs, vset = helpers.make_validators(
+            n_vals, key_factory=_mixed_key_factory
+        )
+    else:
+        privs, vset = helpers.make_validators(n_vals)
     block_id = helpers.make_block_id()
     commit = helpers.make_commit(block_id, 5, 0, vset, privs)
     # warmup (compiles the padded bucket)

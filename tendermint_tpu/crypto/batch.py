@@ -116,6 +116,44 @@ def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
     raise ValueError(f"key type {pub_key.type} does not support batching")
 
 
+class MultiBatchVerifier(BatchVerifier):
+    """Per-key-type sub-batching for MIXED validator sets.
+
+    A 10k-validator commit with ed25519 AND sr25519 signers (BASELINE
+    config 5) splits into one sub-verifier per key type — each riding
+    its own device kernel — and the verdicts merge back in submission
+    order. Key types with no batch support (secp256k1) raise on ``add``,
+    which validation's caller answers with the single-verify fallback,
+    the same contract create_batch_verifier has for an unsupported
+    proposer key (reference crypto/batch/batch.go:11-22 dispatches on
+    ONE key type; this is the mixed-set generalisation)."""
+
+    def __init__(self):
+        self._subs: dict = {}
+        self._order: List[Tuple[str, int]] = []  # (key type, idx in sub)
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        kt = pub_key.type
+        sub = self._subs.get(kt)
+        if sub is None:
+            sub = self._subs[kt] = create_batch_verifier(pub_key)
+        sub.add(pub_key, msg, sig)
+        self._order.append((kt, len(sub) - 1))
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        if not self._order:
+            return False, []  # same empty contract as every BatchVerifier
+        results = {}
+        for kt, sub in self._subs.items():
+            _, oks = sub.verify()
+            results[kt] = oks
+        merged = [bool(results[kt][i]) for kt, i in self._order]
+        return all(merged), merged
+
+
 import threading as _threading
 
 _shared_scheduler = None

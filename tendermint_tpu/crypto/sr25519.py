@@ -229,17 +229,27 @@ class Sr25519PrivKey(PrivKey):
 
 
 class Sr25519BatchVerifier:
-    """Batch verifier: one random-linear-combination multiscalar check.
+    """Batch verifier with a device path and a host fallback.
 
-    Σ zᵢ·(sᵢ·B − kᵢ·Aᵢ − Rᵢ) = 0 with random 128-bit zᵢ — i.e.
-    (Σ zᵢsᵢ)·B − Σ (zᵢkᵢ)·Aᵢ − Σ zᵢ·Rᵢ must be the ristretto identity
-    (reference batch.go:46 → curve25519-voi BatchVerifier.Verify).
-    On batch failure, falls back to per-entry verifies for attribution,
-    mirroring types/validation.go:244-251's needs.
+    Above ``device_threshold`` entries the batch rides the ristretto
+    Straus kernel (ops/sr25519_batch.py — per-entry verdicts, no
+    re-verify needed for attribution). Below it, or when the device is
+    unusable, one random-linear-combination multiscalar check on host:
+    Σ zᵢ·(sᵢ·B − kᵢ·Aᵢ − Rᵢ) = 0 with random 128-bit zᵢ
+    (reference batch.go:46 → curve25519-voi BatchVerifier.Verify),
+    falling back to per-entry verifies for attribution on failure
+    (types/validation.go:244-251).
     """
 
-    def __init__(self):
+    def __init__(self, device_threshold: Optional[int] = None,
+                 use_device: Optional[bool] = None):
+        from tendermint_tpu.crypto.batch import DEVICE_THRESHOLD
+
         self._entries: List[Tuple[bytes, bytes, bytes]] = []
+        self.device_threshold = (
+            DEVICE_THRESHOLD if device_threshold is None else device_threshold
+        )
+        self.use_device = use_device  # None = auto
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
         if pub_key.type != SR25519_KEY_TYPE:
@@ -253,6 +263,21 @@ class Sr25519BatchVerifier:
         n = len(self._entries)
         if n == 0:
             return False, []
+        use_device = self.use_device
+        if use_device is None:
+            use_device = n >= self.device_threshold
+        if use_device:
+            try:
+                from tendermint_tpu.ops.sr25519_batch import verify_batch_sr
+
+                oks = verify_batch_sr(
+                    [e[0] for e in self._entries],
+                    [e[1] for e in self._entries],
+                    [e[2] for e in self._entries],
+                )
+                return all(oks), list(oks)
+            except Exception:
+                pass  # no device engine importable: host path below
         parsed = []
         for pub, msg, sig in self._entries:
             a_point = decompress(pub) if len(pub) == PUBKEY_SIZE else None

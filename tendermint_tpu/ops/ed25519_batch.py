@@ -157,6 +157,44 @@ def _build_lane_table(p: curve.Point) -> jnp.ndarray:
     return jnp.stack([yplusx, yminusx, z, td2], axis=1)
 
 
+def _dbl_step(_, acc_stacked):
+    return jnp.stack(
+        curve.pt_double(
+            (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
+        )
+    )
+
+
+def straus_sb_minus_ka(
+    a_pt: curve.Point, s_win: jnp.ndarray, k_win: jnp.ndarray
+) -> curve.Point:
+    """Shared-doubling double-scalar core: [s]B - [k]A per lane.
+
+    The same 64-step window loop serves both signature schemes on this
+    curve — ed25519 (below) and the schnorrkel/ristretto verifier
+    (ops/sr25519_batch.py): their verification equations are both
+    instances of [s]B - [k]A - R == identity-class.
+    """
+    nn = a_pt[0].shape[1]
+    neg_a = curve.pt_neg(a_pt)
+    a_table = _build_lane_table(neg_a)
+    b_table = jnp.asarray(B_NIELS)
+
+    init = jnp.stack(curve.pt_identity(nn))
+
+    def body(i, acc_stacked):
+        acc_stacked = jax.lax.fori_loop(0, 4, _dbl_step, acc_stacked)
+        acc = (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
+        sd = jax.lax.dynamic_index_in_dim(s_win, i, keepdims=False)
+        kd = jax.lax.dynamic_index_in_dim(k_win, i, keepdims=False)
+        acc = curve.pt_madd(acc, _select_b_niels(sd, b_table))
+        acc = curve.pt_add_cached(acc, _select_lane_cached(kd, a_table))
+        return jnp.stack(acc)
+
+    acc_stacked = jax.lax.fori_loop(0, NWINDOWS, body, init)
+    return (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
+
+
 def verify_kernel(
     pk_bytes: jnp.ndarray,
     r_bytes: jnp.ndarray,
@@ -180,33 +218,10 @@ def verify_kernel(
     r_pt = tuple(c[:, nn:] for c in both_pt)
     a_ok, r_ok = both_ok[:nn], both_ok[nn:]
 
-    neg_a = curve.pt_neg(a_pt)
-    a_table = _build_lane_table(neg_a)
-    b_table = jnp.asarray(B_NIELS)
-
-    init = jnp.stack(curve.pt_identity(nn))
-
-    def dbl(_, acc_stacked):
-        return jnp.stack(
-            curve.pt_double(
-                (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
-            )
-        )
-
-    def body(i, acc_stacked):
-        acc_stacked = jax.lax.fori_loop(0, 4, dbl, acc_stacked)
-        acc = (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
-        sd = jax.lax.dynamic_index_in_dim(s_win, i, keepdims=False)
-        kd = jax.lax.dynamic_index_in_dim(k_win, i, keepdims=False)
-        acc = curve.pt_madd(acc, _select_b_niels(sd, b_table))
-        acc = curve.pt_add_cached(acc, _select_lane_cached(kd, a_table))
-        return jnp.stack(acc)
-
-    acc_stacked = jax.lax.fori_loop(0, NWINDOWS, body, init)
-    acc = (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
+    acc = straus_sb_minus_ka(a_pt, s_win, k_win)
     # [s]B - [k]A computed; subtract R, multiply by cofactor 8, test identity.
     acc = curve.pt_add(acc, curve.pt_neg(r_pt))
-    acc_stacked = jax.lax.fori_loop(0, 3, dbl, jnp.stack(acc))
+    acc_stacked = jax.lax.fori_loop(0, 3, _dbl_step, jnp.stack(acc))
     acc = (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
     return curve.pt_is_identity(acc) & a_ok & r_ok
 
@@ -366,16 +381,22 @@ def _pad_k() -> bytes:
     return _PAD_K
 
 
-def _s_canonical(s_arr: np.ndarray) -> np.ndarray:
-    """(N, 32) little-endian s -> (N,) bool s < L, no Python loop."""
-    s_be = s_arr[:, ::-1].astype(np.int16)
-    diff = s_be - _L_BYTES_BE.astype(np.int16)[None, :]
+def canonical_lt(arr_le: np.ndarray, bound_be: np.ndarray) -> np.ndarray:
+    """(N, 32) little-endian values -> (N,) bool value < bound, no
+    Python loop (shared by the ed25519 s < L and the ristretto
+    encoding < p checks; equality is non-canonical -> False)."""
+    be = arr_le[:, ::-1].astype(np.int16)
+    diff = be - bound_be.astype(np.int16)[None, :]
     nz = diff != 0
     first = np.argmax(nz, axis=1)
-    rows = np.arange(s_arr.shape[0])
+    rows = np.arange(arr_le.shape[0])
     val = diff[rows, first]
-    any_nz = nz.any(axis=1)
-    return np.where(any_nz, val < 0, False)  # s == L is non-canonical
+    return np.where(nz.any(axis=1), val < 0, False)
+
+
+def _s_canonical(s_arr: np.ndarray) -> np.ndarray:
+    """(N, 32) little-endian s -> (N,) bool s < L."""
+    return canonical_lt(s_arr, _L_BYTES_BE)
 
 
 def prepare_batch(

@@ -1,0 +1,284 @@
+"""Batched sr25519 (schnorrkel/ristretto255) verification on device.
+
+The VPU/MXU analog of the reference's sr25519 batch verifier
+(crypto/sr25519/batch.go:15-47 over curve25519-voi): per-lane
+verification of the schnorr equation
+
+    [s_i]B - [k_i]A_i - R_i  ==  ristretto identity
+
+on the SAME twisted-Edwards f32 limb engine as ed25519 — ristretto255
+is a quotient of this curve, so the Straus double-scalar core
+(ops/ed25519_batch.straus_sb_minus_ka) is shared verbatim. What differs:
+
+- point decoding is the RFC 9496 ristretto DECODE map (square-root
+  ratio with the sqrt(-1) fixups), batched here over field32;
+- the accept test is membership in the identity coset — X == 0 or
+  Y == 0 — instead of ed25519's cofactored multiply-by-8;
+- Merlin transcript challenges stay host-side (sequential Keccak duplex
+  — SURVEY §7 "Hard parts"); the device sees only (A, R, s, k) as raw
+  32-byte strings, the transfer-minimal layout of the ed25519 kernel.
+
+Per-entry verdicts (not a random-linear-combination single verdict):
+fault attribution is free, so validation.go:244-251-style fallback
+re-verification is never needed on this path.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.ops import curve32 as curve, field32 as field
+from tendermint_tpu.ops.ed25519_batch import (
+    CHUNK,
+    _bucket,
+    _bytes_to_fe,
+    _to_windows,
+    canonical_lt,
+    straus_sb_minus_ka,
+)
+
+# Canonicity bounds: ristretto encodings must be < p; scalars < L
+# (L imported lazily below to avoid a crypto<->ops import cycle at
+# module load; cached here on first use).
+_P_BYTES_BE = np.frombuffer(field.P.to_bytes(32, "big"), dtype=np.uint8)
+_L_BYTES_BE: Optional[np.ndarray] = None
+
+_NEG_ONE_FE = field.const_fe(field.P - 1)
+_NEG_SQRT_M1_FE = field.const_fe(field.P - field.SQRT_M1)
+
+
+def _l_bytes_be() -> np.ndarray:
+    global _L_BYTES_BE
+    if _L_BYTES_BE is None:
+        from tendermint_tpu.crypto.ristretto import L
+
+        _L_BYTES_BE = np.frombuffer(L.to_bytes(32, "big"), dtype=np.uint8)
+    return _L_BYTES_BE
+
+
+def ristretto_decompress(
+    s_fe: jnp.ndarray,
+) -> Tuple[curve.Point, jnp.ndarray]:
+    """RFC 9496 4.3.1 DECODE, batched: (32, N) f32 limbs (canonical,
+    non-negative — both pre-checked on host bytes) -> (point, valid).
+
+    Invalid lanes hold the identity so downstream arithmetic stays
+    well-defined (same convention as curve32.pt_decompress).
+    """
+    n = s_fe.shape[1]
+    one = field.fe_one(n)
+    ss = field.fe_sq(s_fe)
+    u1 = field.fe_sub(one, ss)
+    u2 = field.fe_add(one, ss)
+    u2s = field.fe_sq(u2)
+    # v = -(D * u1^2) - u2^2
+    v = field.fe_sub(field.fe_neg(field.fe_mul_const(field.fe_sq(u1), field.D_FE)), u2s)
+    # SQRT_RATIO_M1(1, v * u2s): candidate r = w^((p-5)/8) * w^3-ish via
+    # the shared exponent chain; with u = 1 the candidate is
+    # w^3 * (w^7)^((p-5)/8) for w = v*u2s.
+    w = field.fe_mul(v, u2s)
+    w3 = field.fe_mul(field.fe_sq(w), w)
+    w7 = field.fe_mul(field.fe_sq(w3), w)
+    r = field.fe_mul(w3, field.fe_pow22523(w7))
+    check = field.fe_mul(w, field.fe_sq(r))
+    correct = field.fe_eq(check, one)
+    flipped = field.fe_eq(check, jnp.broadcast_to(jnp.asarray(_NEG_ONE_FE), one.shape))
+    flipped_i = field.fe_eq(
+        check, jnp.broadcast_to(jnp.asarray(_NEG_SQRT_M1_FE), one.shape)
+    )
+    r = field.fe_select(
+        flipped | flipped_i, field.fe_mul_const(r, field.SQRT_M1_FE), r
+    )
+    was_square = correct | flipped
+    # |r|: the non-negative square root
+    r = field.fe_select(field.fe_parity(r) == 1.0, field.fe_neg(r), r)
+
+    den_x = field.fe_mul(r, u2)
+    den_y = field.fe_mul(field.fe_mul(r, den_x), v)
+    x = field.fe_mul(field.fe_add(s_fe, s_fe), den_x)
+    x = field.fe_select(field.fe_parity(x) == 1.0, field.fe_neg(x), x)
+    y = field.fe_mul(u1, den_y)
+    t = field.fe_mul(x, y)
+
+    valid = (
+        was_square
+        & (field.fe_parity(t) != 1.0)
+        & ~field.fe_is_zero(y)
+    )
+    pt: curve.Point = (x, y, one, t)
+    return curve.pt_select(valid, pt, curve.pt_identity(n)), valid
+
+
+def verify_kernel_sr(
+    pk_bytes: jnp.ndarray,
+    r_bytes: jnp.ndarray,
+    s_bytes: jnp.ndarray,
+    k_bytes: jnp.ndarray,
+) -> jnp.ndarray:
+    """(N,32)x4 uint8 -> (N,) bool: schnorrkel verify per lane."""
+    a_fe = _bytes_to_fe(pk_bytes)
+    r_fe = _bytes_to_fe(r_bytes)
+    nn = a_fe.shape[1]
+    # One 2N ristretto decode for A and R (same trick as ed25519).
+    both_pt, both_ok = ristretto_decompress(
+        jnp.concatenate([a_fe, r_fe], axis=1)
+    )
+    a_pt = tuple(c[:, :nn] for c in both_pt)
+    r_pt = tuple(c[:, nn:] for c in both_pt)
+    a_ok, r_ok = both_ok[:nn], both_ok[nn:]
+
+    s_win = _to_windows(s_bytes)
+    k_win = _to_windows(k_bytes)
+    acc = straus_sb_minus_ka(a_pt, s_win, k_win)
+    acc = curve.pt_add(acc, curve.pt_neg(r_pt))
+    # ristretto identity coset: X == 0 or Y == 0 (RFC 9496 equality
+    # specialised to the identity; matches crypto/ristretto.equals).
+    x, y, _, _ = acc
+    is_ident = field.fe_is_zero(x) | field.fe_is_zero(y)
+    return is_ident & a_ok & r_ok
+
+
+@lru_cache(maxsize=16)
+def _compiled_kernel_sr(n: int, backend: Optional[str], mul_impl: str = "vpu"):
+    def run(pk, r, s, k):
+        prev = field.get_mul_impl()
+        field.set_mul_impl(mul_impl)
+        try:
+            return verify_kernel_sr(pk, r, s, k)
+        finally:
+            field.set_mul_impl(prev)
+
+    return jax.jit(run, backend=backend)
+
+
+# --- host-side preparation --------------------------------------------------
+
+# Failure policy mirrors ops.verify_batch for real: a backend-init
+# failure is permanent for the process; transient errors retry a few
+# times before the fallback goes sticky.
+_DEVICE_BROKEN = False
+_DEVICE_FAILURES = 0
+_DEVICE_FAILURE_LIMIT = 3
+
+
+def verify_batch_sr(
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    backend: Optional[str] = None,
+) -> List[bool]:
+    """Per-entry schnorrkel batch verification on the device, host
+    Merlin challenges. Large batches dispatch in CHUNK-size launches
+    (one compiled kernel, H2D of chunk j+1 overlapping compute of
+    chunk j); device failure degrades to the host oracle with the same
+    retry-then-sticky policy as ops.verify_batch."""
+    global _DEVICE_BROKEN, _DEVICE_FAILURES
+    from tendermint_tpu.crypto.sr25519 import (
+        _challenge,
+        _signing_transcript,
+        verify as verify_host,
+    )
+
+    n = len(pubkeys)
+    if n == 0:
+        return []
+    if _DEVICE_BROKEN:
+        return [verify_host(p, m, s) for p, m, s in zip(pubkeys, msgs, sigs)]
+
+    host_ok = np.ones(n, dtype=bool)
+    pk_arr = np.zeros((n, 32), dtype=np.uint8)
+    r_arr = np.zeros((n, 32), dtype=np.uint8)
+    s_arr = np.zeros((n, 32), dtype=np.uint8)
+    k_arr = np.zeros((n, 32), dtype=np.uint8)
+    for i, (pub, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
+        if len(pub) != 32 or len(sig) != 64 or not sig[63] & 0x80:
+            host_ok[i] = False
+            continue
+        pk_arr[i] = np.frombuffer(pub, dtype=np.uint8)
+        r_arr[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+        s_raw = bytearray(sig[32:64])
+        s_raw[31] &= 0x7F
+        s_arr[i] = np.frombuffer(bytes(s_raw), dtype=np.uint8)
+        k = _challenge(_signing_transcript(msg), pub, sig[:32])
+        k_arr[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
+    # scalar canonicity: s < L; encodings canonical (< p) and
+    # non-negative (even) for both A and R
+    host_ok &= canonical_lt(s_arr, _l_bytes_be())
+    for enc in (pk_arr, r_arr):
+        host_ok &= canonical_lt(enc, _P_BYTES_BE)
+        host_ok &= (enc[:, 0] & 1) == 0
+
+    try:
+        m = _bucket(n)
+        if m > n:
+            # pad with a known-good lane (a fixed self-consistent triple)
+            pad_pk, pad_r, pad_s, pad_k = _pad_entry()
+            pk_arr = np.concatenate([pk_arr, np.tile(pad_pk, (m - n, 1))])
+            r_arr = np.concatenate([r_arr, np.tile(pad_r, (m - n, 1))])
+            s_arr = np.concatenate([s_arr, np.tile(pad_s, (m - n, 1))])
+            k_arr = np.concatenate([k_arr, np.tile(pad_k, (m - n, 1))])
+        from tendermint_tpu.ops.ed25519_batch import active_impl
+
+        impl = active_impl(backend)
+        mul_impl = "mxu" if impl == "mxu" else field.get_mul_impl()
+        outs = []
+        for lo in range(0, m, CHUNK):
+            hi = min(lo + CHUNK, m)
+            outs.append(
+                _compiled_kernel_sr(hi - lo, backend, mul_impl)(
+                    jnp.asarray(pk_arr[lo:hi]), jnp.asarray(r_arr[lo:hi]),
+                    jnp.asarray(s_arr[lo:hi]), jnp.asarray(k_arr[lo:hi]),
+                )
+            )
+        device_ok = np.concatenate([np.asarray(o) for o in outs])[:n]
+        _DEVICE_FAILURES = 0
+        return list(np.logical_and(device_ok, host_ok))
+    except Exception as exc:
+        _DEVICE_FAILURES += 1
+        text = str(exc).lower()
+        if (
+            isinstance(exc, RuntimeError)
+            and ("backend" in text or "platform" in text)
+        ) or _DEVICE_FAILURES >= _DEVICE_FAILURE_LIMIT:
+            _DEVICE_BROKEN = True
+        import warnings
+
+        warnings.warn(
+            f"sr25519 device batch failed ({exc!r}); host fallback "
+            f"(sticky={_DEVICE_BROKEN})"
+        )
+        return [verify_host(p, m, s) for p, m, s in zip(pubkeys, msgs, sigs)]
+
+
+_PAD: Optional[Tuple[np.ndarray, ...]] = None
+
+
+def _pad_entry() -> Tuple[np.ndarray, ...]:
+    """A known-good (pk, R, s, k) quadruple for padding lanes."""
+    global _PAD
+    if _PAD is None:
+        from tendermint_tpu.crypto.sr25519 import (
+            Sr25519PrivKey,
+            _challenge,
+            _signing_transcript,
+        )
+
+        priv = Sr25519PrivKey.from_secret(b"tendermint-tpu-sr-pad")
+        msg = b"sr25519-pad"
+        sig = priv.sign(msg)
+        pub = priv.pub_key().bytes()
+        s_raw = bytearray(sig[32:64])
+        s_raw[31] &= 0x7F
+        k = _challenge(_signing_transcript(msg), pub, sig[:32])
+        _PAD = (
+            np.frombuffer(pub, dtype=np.uint8),
+            np.frombuffer(sig[:32], dtype=np.uint8),
+            np.frombuffer(bytes(s_raw), dtype=np.uint8),
+            np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8),
+        )
+    return _PAD

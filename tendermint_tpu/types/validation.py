@@ -134,17 +134,19 @@ def _verify_commit_batch(
 ) -> None:
     """validation.go:151-258.
 
-    Divergence: on a mixed-key-type commit (e.g. ed25519 proposer but
-    sr25519 validators in the set), ``bv.add`` rejects the foreign key and
-    we fall back to single verification — which is what the reference's
-    own comment declares (validation.go:49-50 "if verification failed or
-    is not supported then fallback to single verification") but its code
-    never does (the Add error propagates and the commit fails).
+    Divergence (improvement): a mixed ed25519+sr25519 commit sub-batches
+    per key type (crypto/batch.MultiBatchVerifier), each type on its own
+    device kernel — the reference's single-key-type verifier would fail
+    the whole commit. Only keys with no batch support at all (secp256k1)
+    drop to single verification, which is what the reference's comment
+    declares (validation.go:49-50) but its code never does.
     """
     tallied = 0
     seen_vals = {}
     batch_sig_idxs = []
-    bv = crypto_batch.create_batch_verifier(vals.get_proposer().pub_key)
+    # Mixed validator sets sub-batch per key type (BASELINE config 5);
+    # an unsupported key (secp256k1) raises on add -> single fallback.
+    bv = crypto_batch.MultiBatchVerifier()
     for idx, commit_sig in enumerate(commit.signatures):
         if ignore_sig(commit_sig):
             continue

@@ -33,9 +33,14 @@ def make_block_id(seed: bytes = b"block") -> BlockID:
 
 
 def make_validators(
-    n: int, power: int = 10
+    n: int, power: int = 10, key_factory=None
 ) -> Tuple[List[Ed25519PrivKey], ValidatorSet]:
-    privs = [Ed25519PrivKey.from_seed(i.to_bytes(32, "big")) for i in range(n)]
+    """Deterministic validator set; ``key_factory(i) -> PrivKey`` swaps
+    the key scheme per slot (mixed ed25519/sr25519 sets for BASELINE
+    config 5 pass a factory; default is all-ed25519)."""
+    if key_factory is None:
+        key_factory = lambda i: Ed25519PrivKey.from_seed(i.to_bytes(32, "big"))
+    privs = [key_factory(i) for i in range(n)]
     vals = [Validator(p.pub_key(), power) for p in privs]
     vset = ValidatorSet(vals)
     # Sort privkeys to match the canonical validator order (by power desc,
