@@ -202,6 +202,9 @@ def cmd_start(args) -> int:
         last_height = -1
         while True:
             time.sleep(0.2)
+            if node.failed is not None:
+                print(f"error: {node.failed}", file=sys.stderr, flush=True)
+                return 1
             if node.height != last_height:
                 last_height = node.height
                 print(f"height={last_height}", flush=True)

@@ -61,6 +61,9 @@ class P2PConfig:
     max_connections: int = 16
     send_rate: int = 5120000  # bytes/sec per peer (config.go SendRate)
     recv_rate: int = 5120000
+    # Per-peer send-queue discipline: fifo | priority | simple-priority
+    # (router.go:216-238 QueueType).
+    queue_type: str = "fifo"
 
 
 @dataclass
@@ -79,6 +82,16 @@ class PrivValidatorConfig:
 
 
 @dataclass
+class ConsensusConfig:
+    """config/config.go ConsensusConfig (condensed — timeouts live
+    on-chain in ConsensusParams; this holds node-local knobs)."""
+
+    # Refuse to join consensus if our key signed a commit within the
+    # last N blocks (config.go:961 DoubleSignCheckHeight; 0 = off).
+    double_sign_check_height: int = 0
+
+
+@dataclass
 class IndexerConfig:
     enabled: bool = True
 
@@ -94,6 +107,7 @@ class Config:
     privval: PrivValidatorConfig = dc_field(
         default_factory=PrivValidatorConfig
     )
+    consensus: ConsensusConfig = dc_field(default_factory=ConsensusConfig)
     indexer: IndexerConfig = dc_field(default_factory=IndexerConfig)
 
     # --- derived paths ------------------------------------------------------
@@ -141,11 +155,16 @@ class Config:
             log_level=self.base.log_level,
             p2p_send_rate=self.p2p.send_rate,
             p2p_recv_rate=self.p2p.recv_rate,
+            p2p_queue_type=self.p2p.queue_type,
+            double_sign_check_height=self.consensus.double_sign_check_height,
         )
 
     # --- TOML ---------------------------------------------------------------
 
-    _SECTIONS = ("base", "p2p", "rpc", "mempool", "statesync", "privval", "indexer")
+    _SECTIONS = (
+        "base", "p2p", "rpc", "mempool", "statesync", "privval",
+        "consensus", "indexer",
+    )
 
     def to_toml(self) -> str:
         out = [

@@ -56,6 +56,11 @@ from tendermint_tpu.types.vote_set import (
 )
 
 
+class DoubleSigningRiskError(RuntimeError):
+    """state.go ErrSignatureFoundInPastBlocks: our key signed a recent
+    commit — joining consensus now risks equivocation."""
+
+
 class Broadcaster:
     """Outbound gossip seam (the consensus reactor implements this)."""
 
@@ -87,6 +92,7 @@ class ConsensusState:
         on_committed: Optional[Callable[[int], None]] = None,
         metrics=None,
         logger=None,
+        double_sign_check_height: int = 0,
     ):
         from tendermint_tpu.libs.log import NOP_LOGGER
         from tendermint_tpu.libs.metrics import ConsensusMetrics
@@ -104,6 +110,10 @@ class ConsensusState:
         self.metrics = metrics or ConsensusMetrics.nop()
         self.logger = (logger or NOP_LOGGER).with_fields(module="consensus")
         self._last_commit_walltime: Optional[float] = None
+        # Double-signing risk reduction lookback (config.go:961
+        # double-sign-check-height; 0 disables).
+        self.double_sign_check_height = double_sign_check_height
+        self._ds_cleared_height: Optional[int] = None
 
         self.rs = cstypes.RoundState()
         self.state = SMState()  # set by _update_to_state
@@ -139,15 +149,61 @@ class ConsensusState:
         self._update_to_state(sm_state)
 
     def start(self) -> None:
-        """OnStart (state.go:399): WAL + replay + receive routine + round 0."""
+        """OnStart (state.go:399): WAL + replay + double-sign risk check
+        + receive routine + round 0."""
         self.wal.start()
         self._catchup_replay()
+        self.check_double_signing_risk()
         self._stop_flag.clear()
         self._thread = threading.Thread(
             target=self._receive_routine, name="consensus-receive", daemon=True
         )
         self._thread.start()
         self._schedule_round_0()
+
+    def check_double_signing_risk(self, height: Optional[int] = None) -> None:
+        """state.go checkDoubleSigningRisk:2663 — before joining
+        consensus, look back ``double_sign_check_height`` blocks for a
+        commit signature from OUR key. Finding one means another process
+        with this key signed recently (or we restarted into rounds we
+        already signed): refuse to start rather than risk equivocating.
+        0 disables (config.go:961 default).
+
+        Public: the Node calls it eagerly at start so the common restart
+        case fails the whole process; start() calls it again in case the
+        height moved (blocksync), and a height already cleared is not
+        re-scanned."""
+        if height is None:
+            height = self.rs.height
+        if (
+            self.priv_validator is None
+            or self.priv_pub_key is None
+            or self.double_sign_check_height <= 0
+            or height <= 0
+            or self._ds_cleared_height == height
+        ):
+            return
+        from tendermint_tpu.types.block import BLOCK_ID_FLAG_COMMIT
+
+        val_addr = self.priv_pub_key.address()
+        lookback = min(self.double_sign_check_height, height)
+        for i in range(1, lookback):
+            commit = self.block_store.load_block_commit(height - i)
+            if commit is None:
+                commit = self.block_store.load_seen_commit()
+                if commit is None or commit.height != height - i:
+                    continue
+            for sig_idx, s in enumerate(commit.signatures):
+                if (
+                    s.block_id_flag == BLOCK_ID_FLAG_COMMIT
+                    and s.validator_address == val_addr
+                ):
+                    raise DoubleSigningRiskError(
+                        f"signature from this validator's key found "
+                        f"{i} block(s) back (height {height - i}, sig "
+                        f"#{sig_idx}); refusing to join consensus"
+                    )
+        self._ds_cleared_height = height
 
     def stop(self) -> None:
         self._stop_flag.set()

@@ -228,6 +228,9 @@ class Sr25519PrivKey(PrivKey):
         return SR25519_KEY_TYPE
 
 
+_OPS_IMPORT_WARNED = False  # one warning per process for a jax-less install
+
+
 class Sr25519BatchVerifier:
     """Batch verifier with a device path and a host fallback.
 
@@ -269,15 +272,29 @@ class Sr25519BatchVerifier:
         if use_device:
             try:
                 from tendermint_tpu.ops.sr25519_batch import verify_batch_sr
+            except ImportError:
+                # No device engine in this install (jax absent): warn
+                # once, then stop trying for the life of the process.
+                global _OPS_IMPORT_WARNED
+                if not _OPS_IMPORT_WARNED:
+                    _OPS_IMPORT_WARNED = True
+                    import warnings
 
+                    warnings.warn(
+                        "sr25519 device engine unavailable (ops import "
+                        "failed); using host batch verification"
+                    )
+                self.use_device = False
+            else:
+                # verify_batch_sr handles device failures itself
+                # (warn + shared sticky policy) and returns host-oracle
+                # verdicts on fallback.
                 oks = verify_batch_sr(
                     [e[0] for e in self._entries],
                     [e[1] for e in self._entries],
                     [e[2] for e in self._entries],
                 )
                 return all(oks), list(oks)
-            except Exception:
-                pass  # no device engine importable: host path below
         parsed = []
         for pub, msg, sig in self._entries:
             a_point = decompress(pub) if len(pub) == PUBKEY_SIZE else None

@@ -76,6 +76,11 @@ class NodeConfig:
     # Per-peer connection rate limits (config.go P2P SendRate/RecvRate).
     p2p_send_rate: int = 5120000
     p2p_recv_rate: int = 5120000
+    # Per-peer send-queue discipline (router.go:216-238).
+    p2p_queue_type: str = "fifo"
+    # Refuse to join consensus if our key signed a commit within the
+    # last N blocks (config.go:961 double-sign-check-height; 0 = off).
+    double_sign_check_height: int = 0
     # State sync (config/config.go StateSyncConfig): None disables.
     statesync: Optional["StateSyncConfig"] = None
 
@@ -107,6 +112,9 @@ class Node:
         self.node_key = node_key
         self._signer_endpoint = None
         self._owned_signer = None  # gRPC signer client the node must close
+        # Fatal error raised by a background routine (e.g. a post-sync
+        # double-sign refusal); the operator loop polls this.
+        self.failed: Optional[Exception] = None
         if priv_validator is None and config.priv_validator_laddr.startswith(
             "grpc://"
         ):
@@ -296,6 +304,7 @@ class Node:
             transport,
             metrics=p2p_metrics,
             logger=self.logger,
+            queue_type=config.p2p_queue_type,
         )
 
         # --- consensus (node.go:297-325) -------------------------------------
@@ -312,6 +321,7 @@ class Node:
             wal=wal,
             metrics=consensus_metrics,
             logger=self.logger,
+            double_sign_check_height=config.double_sign_check_height,
         )
         self.consensus.event_bus = self.event_bus
         self.consensus_reactor = ConsensusReactor(self.consensus, self.router)
@@ -394,6 +404,11 @@ class Node:
 
     def start(self) -> None:
         """OnStart ordering (node.go:403-519)."""
+        self.failed = None
+        # Double-sign risk check FIRST (state.go:2663 via OnStart:472):
+        # the common restart case must fail the whole node start, not a
+        # background sync thread later.
+        self.consensus.check_double_signing_risk()
         self.router.start()
         self.pex_reactor.start()
         self.evidence_reactor.start()
@@ -498,7 +513,15 @@ class Node:
                 complete=True, height=state.last_block_height
             )
         )
-        self.consensus.start()
+        try:
+            self.consensus.start()
+        except Exception as exc:
+            # Refusals after a sync (our signatures found in blocks we
+            # just synced) happen on a background thread; record them so
+            # the operator loop can exit instead of running a zombie
+            # node that never joins consensus.
+            self.failed = exc
+            self.logger.error("consensus refused to start", err=str(exc))
 
     def stop(self) -> None:
         if self.rpc_server is not None:

@@ -1,0 +1,52 @@
+"""Shared device-fallback policy for the batch verification kernels.
+
+One process-wide answer to "is the accelerator usable?": a failure to
+initialize any jax backend is permanent for the process; transient
+errors (an OOM, a flaky launch) retry a few times before the fallback
+goes sticky. Both signature engines (ops/ed25519_batch.py,
+ops/sr25519_batch.py) consult the SAME instance, so a backend declared
+broken by one path is immediately broken for the other — no second
+burn-in of failed launches.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class DevicePolicy:
+    FAILURE_LIMIT = 3
+
+    def __init__(self):
+        self._mtx = threading.Lock()
+        self.broken = False
+        self.failures = 0
+
+    @staticmethod
+    def _is_backend_init_failure(exc: Exception) -> bool:
+        """No jax backend could come up at all (e.g. the axon plugin not
+        registering in a subprocess) — permanent for this process."""
+        text = str(exc).lower()
+        return isinstance(exc, RuntimeError) and (
+            "backend" in text or "platform" in text
+        )
+
+    def record_failure(self, exc: Exception) -> bool:
+        """Returns True when the device path is now (or already) sticky-
+        broken."""
+        with self._mtx:
+            self.failures += 1
+            if (
+                self._is_backend_init_failure(exc)
+                or self.failures >= self.FAILURE_LIMIT
+            ):
+                self.broken = True
+            return self.broken
+
+    def record_success(self) -> None:
+        with self._mtx:
+            self.failures = 0
+
+
+# The process-wide instance both engines share.
+shared = DevicePolicy()

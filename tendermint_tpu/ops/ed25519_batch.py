@@ -280,18 +280,8 @@ def _compiled_kernel(n: int, backend: Optional[str], mul_impl: str = "vpu"):
 
 _IMPL_ENV = "TENDERMINT_TPU_VERIFY_IMPL"
 _PALLAS_BROKEN = False  # sticky per-process fallback after a failure
-_DEVICE_BROKEN = False  # sticky host fallback when no backend initializes
-_DEVICE_FAILURES = 0  # consecutive non-init failures before going sticky
-_DEVICE_FAILURE_LIMIT = 3
-
-
-def _is_backend_init_failure(exc: Exception) -> bool:
-    """A failure to bring up any jax backend at all (e.g. the axon plugin
-    not registering in a subprocess) — permanent for this process."""
-    text = str(exc)
-    return isinstance(exc, RuntimeError) and (
-        "backend" in text.lower() or "platform" in text.lower()
-    )
+# Device-vs-host fallback state lives in ops/device_policy.py, shared
+# with the sr25519 engine so a broken backend is broken once.
 
 
 def _platform(backend: Optional[str]) -> str:
@@ -474,11 +464,12 @@ def verify_batch(
     back-to-back so H2D transfer of chunk j+1 overlaps compute of
     chunk j (JAX async dispatch).
     """
-    global _DEVICE_BROKEN, _DEVICE_FAILURES
+    from tendermint_tpu.ops.device_policy import shared as device_policy
+
     n = len(pubkeys)
     if n == 0:
         return []
-    if not _DEVICE_BROKEN:
+    if not device_policy.broken:
         try:
             inputs, host_ok = prepare_batch(
                 pubkeys, msgs, sigs, pad_to=_bucket(n)
@@ -489,27 +480,19 @@ def verify_batch(
                 hi = min(lo + CHUNK, m)
                 outs.append(_run_chunk(inputs, lo, hi, backend))
             device_ok = np.concatenate([np.asarray(o) for o in outs])[:n]
-            _DEVICE_FAILURES = 0
+            device_policy.record_success()
             return list(np.logical_and(device_ok, host_ok))
         except Exception as exc:
             # Verification must never take the node down over
-            # infrastructure — degrade to the host oracle. A failure to
-            # initialize any backend (e.g. the axon plugin not
-            # registering in a subprocess) is permanent for the process;
-            # anything else (transient device error, odd batch) retries
-            # the device a few times before going sticky, so one OOM
-            # doesn't cost the whole process its device path.
-            _DEVICE_FAILURES += 1
-            if (
-                _is_backend_init_failure(exc)
-                or _DEVICE_FAILURES >= _DEVICE_FAILURE_LIMIT
-            ):
-                _DEVICE_BROKEN = True
+            # infrastructure — degrade to the host oracle. The shared
+            # policy (ops/device_policy.py) decides when the fallback
+            # goes sticky for the whole process and BOTH engines.
+            sticky = device_policy.record_failure(exc)
             import warnings
 
             warnings.warn(
                 f"device batch verify failed ({exc!r}); host fallback "
-                f"(sticky={_DEVICE_BROKEN})"
+                f"(sticky={sticky})"
             )
     from tendermint_tpu.crypto.ed25519_ref import verify_zip215
 

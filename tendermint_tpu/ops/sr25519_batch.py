@@ -158,13 +158,6 @@ def _compiled_kernel_sr(n: int, backend: Optional[str], mul_impl: str = "vpu"):
 
 # --- host-side preparation --------------------------------------------------
 
-# Failure policy mirrors ops.verify_batch for real: a backend-init
-# failure is permanent for the process; transient errors retry a few
-# times before the fallback goes sticky.
-_DEVICE_BROKEN = False
-_DEVICE_FAILURES = 0
-_DEVICE_FAILURE_LIMIT = 3
-
 
 def verify_batch_sr(
     pubkeys: Sequence[bytes],
@@ -175,19 +168,19 @@ def verify_batch_sr(
     """Per-entry schnorrkel batch verification on the device, host
     Merlin challenges. Large batches dispatch in CHUNK-size launches
     (one compiled kernel, H2D of chunk j+1 overlapping compute of
-    chunk j); device failure degrades to the host oracle with the same
-    retry-then-sticky policy as ops.verify_batch."""
-    global _DEVICE_BROKEN, _DEVICE_FAILURES
+    chunk j); device failure degrades to the host oracle under the
+    process-wide policy shared with ed25519 (ops/device_policy.py)."""
     from tendermint_tpu.crypto.sr25519 import (
         _challenge,
         _signing_transcript,
         verify as verify_host,
     )
+    from tendermint_tpu.ops.device_policy import shared as device_policy
 
     n = len(pubkeys)
     if n == 0:
         return []
-    if _DEVICE_BROKEN:
+    if device_policy.broken:
         return [verify_host(p, m, s) for p, m, s in zip(pubkeys, msgs, sigs)]
 
     host_ok = np.ones(n, dtype=bool)
@@ -236,21 +229,15 @@ def verify_batch_sr(
                 )
             )
         device_ok = np.concatenate([np.asarray(o) for o in outs])[:n]
-        _DEVICE_FAILURES = 0
+        device_policy.record_success()
         return list(np.logical_and(device_ok, host_ok))
     except Exception as exc:
-        _DEVICE_FAILURES += 1
-        text = str(exc).lower()
-        if (
-            isinstance(exc, RuntimeError)
-            and ("backend" in text or "platform" in text)
-        ) or _DEVICE_FAILURES >= _DEVICE_FAILURE_LIMIT:
-            _DEVICE_BROKEN = True
+        sticky = device_policy.record_failure(exc)
         import warnings
 
         warnings.warn(
             f"sr25519 device batch failed ({exc!r}); host fallback "
-            f"(sticky={_DEVICE_BROKEN})"
+            f"(sticky={sticky})"
         )
         return [verify_host(p, m, s) for p, m, s in zip(pubkeys, msgs, sigs)]
 

@@ -67,10 +67,21 @@ class Router:
         metrics=None,
         logger=None,
         max_incoming_per_ip: int = 16,
+        queue_type: str = "fifo",
+        channel_priorities=None,
     ):
         from tendermint_tpu.libs.log import NOP_LOGGER
         from tendermint_tpu.libs.metrics import P2PMetrics
+        from tendermint_tpu.p2p.pqueue import QUEUE_TYPES
 
+        if queue_type not in QUEUE_TYPES:
+            raise ValueError(
+                f"unknown p2p queue type {queue_type!r} (one of {QUEUE_TYPES})"
+            )
+        # Per-peer send-queue discipline (router.go:216-238): fifo,
+        # priority (WDRR), or simple-priority.
+        self.queue_type = queue_type
+        self.channel_priorities = channel_priorities
         self.node_info = node_info
         self.peer_manager = peer_manager
         self.transport = transport
@@ -196,7 +207,11 @@ class Router:
             conn.close()
             return
         peer_id = peer_info.node_id
-        send_q: "queue.Queue" = queue.Queue(maxsize=10000)
+        from tendermint_tpu.p2p.pqueue import make_send_queue
+
+        send_q = make_send_queue(
+            self.queue_type, 10000, self.channel_priorities
+        )
         with self._mtx:
             old = self._peer_conns.pop(peer_id, None)
             old_ip = self._peer_ips.pop(peer_id, None)
@@ -222,12 +237,11 @@ class Router:
     def _send_peer(self, peer_id: NodeID, conn: Connection, send_q) -> None:
         """router.go sendPeer:843."""
         while not self._stop_flag.is_set():
-            try:
-                env = send_q.get(timeout=0.2)
-            except queue.Empty:
-                continue
+            env = send_q.get(timeout=0.2)
             if env is None:
-                return
+                if send_q.closed:
+                    return
+                continue  # timeout: poll the stop flag
             try:
                 conn.send(env.channel_id, env.message)
                 self.metrics.message_send_bytes_total.labels(
@@ -279,34 +293,25 @@ class Router:
             self.logger.info("peer disconnected", peer=peer_id[:16])
             conn.close()
             if sq is not None:
-                try:
-                    sq.put_nowait(None)
-                except queue.Full:
-                    pass
+                sq.close()
             self.peer_manager.disconnected(peer_id)
 
     # --- routing --------------------------------------------------------------
 
     def _route_out(self, env: Envelope) -> None:
-        """router.go routeChannel:301."""
+        """router.go routeChannel:301. The queue discipline decides what
+        a full queue drops (pqueue.py); drops are silent here, as in the
+        reference."""
         if env.broadcast:
             with self._mtx:
                 targets = list(self._peer_send_queues.items())
             for peer_id, sq in targets:
-                try:
-                    sq.put_nowait(
-                        Envelope(env.channel_id, env.message, to_peer=peer_id)
-                    )
-                except queue.Full:
-                    pass
+                sq.put(Envelope(env.channel_id, env.message, to_peer=peer_id))
         else:
             with self._mtx:
                 sq = self._peer_send_queues.get(env.to_peer)
             if sq is not None:
-                try:
-                    sq.put_nowait(env)
-                except queue.Full:
-                    pass
+                sq.put(env)
 
     def connected_peers(self) -> List[NodeID]:
         with self._mtx:

@@ -549,3 +549,73 @@ class TestLocking:
             assert h.cs.rs.locked_round == 0
         finally:
             h.cs.stop()
+
+
+class TestDoubleSignRiskGuard:
+    def test_restart_with_recent_own_signature_refuses(self, tmp_path):
+        """state.go checkDoubleSigningRisk:2663: a validator whose key
+        signed a commit within the lookback window must refuse to join
+        consensus (the migrate-a-validator protection)."""
+        from tendermint_tpu.consensus.state import DoubleSigningRiskError
+
+        cs, privs, app = build_validator(tmp_path)
+        cs.start()
+        assert wait_for_height([cs], 3)
+        cs.stop()
+
+        sm_state = cs.block_exec.state_store.load()
+        cs2 = ConsensusState(
+            sm_state,
+            cs.block_exec,
+            cs.block_store,
+            priv_validator=privs[0],
+            wal=WAL(str(tmp_path / "wal0.log")),
+            double_sign_check_height=10,
+        )
+        with pytest.raises(DoubleSigningRiskError):
+            cs2.start()
+        cs2.stop()
+
+    def test_restart_disabled_guard_proceeds(self, tmp_path):
+        """Default double_sign_check_height=0 keeps today's restart
+        behavior (WAL replay, no refusal)."""
+        cs, privs, app = build_validator(tmp_path)
+        cs.start()
+        assert wait_for_height([cs], 2)
+        cs.stop()
+        sm_state = cs.block_exec.state_store.load()
+        cs2 = ConsensusState(
+            sm_state,
+            cs.block_exec,
+            cs.block_store,
+            priv_validator=privs[0],
+            wal=WAL(str(tmp_path / "wal0.log")),
+        )
+        cs2.start()
+        try:
+            assert wait_for_height([cs2], cs.block_store.height() + 1)
+        finally:
+            cs2.stop()
+
+    def test_unsigned_lookback_window_proceeds(self, tmp_path):
+        """A key with NO signatures in the window (fresh validator key
+        joining an existing chain) starts normally even with the guard
+        enabled."""
+        cs, privs, app = build_validator(tmp_path)
+        cs.start()
+        assert wait_for_height([cs], 2)
+        cs.stop()
+        sm_state = cs.block_exec.state_store.load()
+        other = FilePV.generate(
+            str(tmp_path / "okey.json"), str(tmp_path / "ostate.json")
+        )
+        cs2 = ConsensusState(
+            sm_state,
+            cs.block_exec,
+            cs.block_store,
+            priv_validator=other,  # not in the validator set: observer
+            wal=WAL(str(tmp_path / "wal-obs.log")),
+            double_sign_check_height=10,
+        )
+        cs2.start()  # must NOT raise: no own signature in the window
+        cs2.stop()

@@ -1,0 +1,194 @@
+"""Per-peer send-queue disciplines (p2p/pqueue.py) and their
+backpressure behavior under a stalled peer.
+
+Reference: internal/p2p/router.go:216-238 (queue factory), pqueue.go
+(WDRR), rqueue.go (simple priority). The VERDICT-named gap: with one
+FIFO, a flooding peer starves consensus traffic; these tests pin what
+each discipline drops when the queue is full.
+"""
+
+import time
+
+import pytest
+
+from tendermint_tpu.p2p.pqueue import (
+    DEFAULT_PRIORITIES,
+    FIFOQueue,
+    SimplePriorityQueue,
+    WDRRQueue,
+    make_send_queue,
+)
+from tendermint_tpu.p2p.router import Envelope
+
+BLOCKSYNC = 0x40  # priority 5
+VOTE = 0x22  # priority 10
+LIGHT_BLOCK = 0x62  # priority 2
+
+
+def _env(ch, i=0):
+    return Envelope(ch, b"m%d" % i)
+
+
+# --- factory ----------------------------------------------------------------
+
+
+def test_factory_selects_types():
+    assert isinstance(make_send_queue("fifo", 4), FIFOQueue)
+    assert isinstance(make_send_queue("priority", 4), WDRRQueue)
+    assert isinstance(make_send_queue("simple-priority", 4), SimplePriorityQueue)
+    with pytest.raises(ValueError):
+        make_send_queue("wdrr", 4)
+
+
+# --- FIFO -------------------------------------------------------------------
+
+
+def test_fifo_drops_new_on_full():
+    q = FIFOQueue(3)
+    assert all(q.put(_env(BLOCKSYNC, i)) for i in range(3))
+    assert not q.put(_env(VOTE, 99))  # fifo has no priority lane
+    assert q.get().message == b"m0"
+
+
+def test_fifo_close_wakes_getter():
+    q = FIFOQueue(3)
+    import threading
+
+    got = []
+    t = threading.Thread(target=lambda: got.append(q.get(timeout=5)))
+    t.start()
+    time.sleep(0.05)
+    q.close()
+    t.join(timeout=2)
+    assert got == [None] and q.closed
+
+
+# --- WDRR priority ----------------------------------------------------------
+
+
+def test_wdrr_full_queue_protects_consensus_votes():
+    """The stalled-peer scenario: a blocksync flood fills the queue;
+    an arriving consensus vote evicts a blocksync envelope instead of
+    being dropped, and queued votes are never cannibalised by more
+    blocksync traffic."""
+    q = WDRRQueue(8)
+    for i in range(8):
+        assert q.put(_env(BLOCKSYNC, i))
+    assert len(q) == 8
+    # vote outranks blocksync: admitted by evicting the OLDEST blocksync
+    assert q.put(_env(VOTE, 100))
+    assert len(q) == 8
+    assert q.dropped.get(BLOCKSYNC) == 1
+    # more blocksync at full with an equal-priority floor: dropped
+    assert not q.put(_env(BLOCKSYNC, 9))
+    assert q.dropped.get(BLOCKSYNC) == 2
+    # lower-priority statesync traffic is dropped too, not the vote
+    assert not q.put(_env(LIGHT_BLOCK, 0))
+    # the vote is still queued and dequeues ahead of the flood
+    first = q.get()
+    assert first.channel_id == VOTE
+
+
+def test_wdrr_low_priority_not_starved():
+    """WRR (not strict priority): under a sustained high-priority
+    stream, low-priority envelopes still dequeue — at most `priority`
+    high envelopes per round."""
+    q = WDRRQueue(100)
+    for i in range(30):
+        q.put(_env(VOTE, i))
+    q.put(_env(LIGHT_BLOCK, 0))
+    order = [q.get().channel_id for _ in range(31)]
+    pos = order.index(LIGHT_BLOCK)
+    # votes have priority 10: the light-block envelope must appear
+    # within the first round (10 votes + lower lanes), not after all 30
+    assert pos <= 12, f"light block starved until position {pos}"
+
+
+def test_wdrr_incoming_lowest_is_dropped():
+    q = WDRRQueue(4)
+    for i in range(4):
+        assert q.put(_env(VOTE, i))
+    assert not q.put(_env(LIGHT_BLOCK, 0))  # nothing lower to evict
+    assert len(q) == 4
+
+
+# --- simple priority --------------------------------------------------------
+
+
+def test_simple_priority_orders_strictly():
+    q = SimplePriorityQueue(10)
+    q.put(_env(BLOCKSYNC, 0))
+    q.put(_env(VOTE, 1))
+    q.put(_env(LIGHT_BLOCK, 2))
+    q.put(_env(VOTE, 3))
+    got = [q.get().channel_id for _ in range(4)]
+    assert got == [VOTE, VOTE, BLOCKSYNC, LIGHT_BLOCK]
+
+
+def test_simple_priority_fifo_within_class_and_eviction():
+    q = SimplePriorityQueue(3)
+    q.put(_env(VOTE, 0))
+    q.put(_env(BLOCKSYNC, 1))
+    q.put(_env(BLOCKSYNC, 2))
+    # full; a vote evicts the newest lowest-priority envelope
+    assert q.put(_env(VOTE, 3))
+    assert q.dropped.get(BLOCKSYNC) == 1
+    got = [q.get().message for _ in range(3)]
+    assert got == [b"m0", b"m3", b"m1"]  # votes FIFO, then old blocksync
+    # full of votes: lower-priority incoming is rejected
+    q2 = SimplePriorityQueue(2)
+    q2.put(_env(VOTE, 0))
+    q2.put(_env(VOTE, 1))
+    assert not q2.put(_env(BLOCKSYNC, 9))
+
+
+# --- router integration -----------------------------------------------------
+
+
+def test_router_uses_configured_discipline():
+    from tests.test_p2p import make_router
+    from tendermint_tpu.p2p.transport import MemoryNetwork
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.p2p.peermanager import PeerAddress, PeerManager
+    from tendermint_tpu.p2p.router import Router
+    from tendermint_tpu.p2p.transport import NodeInfo
+
+    net = MemoryNetwork()
+    nk = NodeKey.generate()
+    info = NodeInfo(node_id=nk.node_id, network="q-chain", listen_addr="nq1")
+    pm = PeerManager(nk.node_id)
+    r1 = Router(info, pm, net.transport("nq1"), queue_type="priority")
+    r2, nk2, pm2 = make_router(net, "nq2", chain="q-chain")
+    ch_vote = r1.open_channel(VOTE)
+    ch_bs = r1.open_channel(BLOCKSYNC)
+    r2.open_channel(VOTE)
+    r2.open_channel(BLOCKSYNC)
+    r1.start()
+    r2.start()
+    try:
+        pm.add_address(PeerAddress(nk2.node_id, "nq2"))
+        deadline = time.monotonic() + 5
+        while not r1.connected_peers() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert r1.connected_peers() == [nk2.node_id]
+        sq = r1._peer_send_queues[nk2.node_id]
+        assert isinstance(sq, WDRRQueue)
+        ch_vote.broadcast(b"a vote")
+        ch_bs.broadcast(b"a block")
+    finally:
+        r1.stop()
+        r2.stop()
+
+
+def test_router_rejects_unknown_queue_type():
+    from tendermint_tpu.p2p.key import NodeKey
+    from tendermint_tpu.p2p.peermanager import PeerManager
+    from tendermint_tpu.p2p.router import Router
+    from tendermint_tpu.p2p.transport import MemoryNetwork, NodeInfo
+
+    net = MemoryNetwork()
+    nk = NodeKey.generate()
+    info = NodeInfo(node_id=nk.node_id, network="x", listen_addr="nx")
+    with pytest.raises(ValueError):
+        Router(info, PeerManager(nk.node_id), net.transport("nx"),
+               queue_type="bogus")
