@@ -16,6 +16,7 @@ The cmd/tendermint analog (main.go:29-61). Commands:
   wal2json        decode a consensus WAL to JSON records
   abci            drive an ABCI socket app (info/echo/query/check-tx)
   compact-db      drop dead filedb records (node stopped)
+  key-migrate     re-encode every store into another backend/engine dir
   reindex-event   rebuild the tx/block index from stored blocks
   confix          migrate config.toml to the current schema
 
@@ -645,6 +646,57 @@ def cmd_reindex_event(args) -> int:
     return 0
 
 
+def cmd_key_migrate(args) -> int:
+    """scripts/keymigrate (cmd/tendermint/main.go:29-61 key-migrate):
+    re-encode every store into a (possibly different) backend. The
+    reference migrates legacy key formats to orderedcode in place; here
+    the same walk serves backend migration (filedb <-> memdb snapshots,
+    forcing the C++ or Python filedb engine), which is this tree's
+    only key-format seam. Run on a STOPPED node."""
+    from tendermint_tpu.storage import open_db
+
+    cfg = Config(home=args.home)
+    data = cfg.data_dir()
+    if not os.path.isdir(data):
+        raise FileNotFoundError(data)
+    names = sorted(
+        f[: -len(".fdb")] for f in os.listdir(data) if f.endswith(".fdb")
+    )
+    if not names:
+        print(f"no databases to migrate in {data}")
+        return 0
+    out_dir = args.out or (data.rstrip(os.sep) + "-migrated")
+    if os.path.abspath(out_dir) == os.path.abspath(data):
+        print("error: --out must differ from the data dir", file=sys.stderr)
+        return 1
+    if os.path.isdir(out_dir) and os.listdir(out_dir):
+        # Merging into a stale snapshot would silently keep records the
+        # source has since deleted — a corrupt "migration".
+        print(
+            f"error: output dir {out_dir} is not empty; remove it or "
+            "pass a fresh --out",
+            file=sys.stderr,
+        )
+        return 1
+    os.makedirs(out_dir, exist_ok=True)
+    for name in names:
+        src = open_db("filedb", data, name)
+        dst = open_db(args.to_backend, out_dir, name)
+        n = 0
+        batch = dst.new_batch()
+        for k, v in src.iterator():
+            batch.set(k, v)
+            n += 1
+            if n % 10000 == 0:
+                batch.write()
+                batch = dst.new_batch()
+        batch.write()
+        src.close()
+        dst.close()
+        print(f"{name}: migrated {n} keys -> {args.to_backend} in {out_dir}")
+    return 0
+
+
 def cmd_compact_db(args) -> int:
     """commands/compact.go analog: rewrite every filedb in <home>/data
     dropping dead (overwritten/deleted) records. Run on a STOPPED node."""
@@ -845,6 +897,17 @@ def build_parser() -> argparse.ArgumentParser:
         "compact-db", help="compact filedb databases (node stopped)"
     )
     p.set_defaults(fn=cmd_compact_db)
+
+    p = sub.add_parser(
+        "key-migrate",
+        help="re-encode every store into another backend/engine dir",
+    )
+    p.add_argument(
+        "--to-backend", default="filedb-c",
+        choices=["filedb", "filedb-c", "filedb-py"],
+    )
+    p.add_argument("--out", default="", help="output data dir (must differ)")
+    p.set_defaults(fn=cmd_key_migrate)
 
     p = sub.add_parser(
         "reindex-event",

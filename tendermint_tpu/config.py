@@ -71,6 +71,9 @@ class RPCConfig:
     """config/config.go RPCConfig (condensed)."""
 
     laddr: str = "127.0.0.1:26657"
+    # Register unsafe operator routes (config.go Unsafe; routes.go
+    # AddUnsafeRoutes): disconnect etc. Off by default.
+    unsafe: bool = False
 
 
 @dataclass
@@ -94,6 +97,10 @@ class ConsensusConfig:
 @dataclass
 class IndexerConfig:
     enabled: bool = True
+    # Event sinks: kv | null | sql (reference indexer sink list,
+    # config.go TxIndexConfig.Indexer; "sql" is the psql schema over
+    # sqlite3 — see indexer/sink.py).
+    sinks: List[str] = dc_field(default_factory=lambda: ["kv"])
 
 
 @dataclass
@@ -147,7 +154,9 @@ class Config:
             max_connections=self.p2p.max_connections,
             moniker=self.base.moniker,
             rpc_laddr=self.rpc.laddr,
+            rpc_unsafe=self.rpc.unsafe,
             tx_index=self.indexer.enabled,
+            tx_index_sinks=list(self.indexer.sinks),
             db_backend=self.base.db_backend,
             statesync=self.statesync if self.statesync.enabled else None,
             priv_validator_laddr=self.privval.laddr,

@@ -31,7 +31,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List
 
 VALID_MODES = ("validator", "full")
-VALID_PERTURBATIONS = ("kill", "pause", "restart")
+VALID_PERTURBATIONS = ("kill", "pause", "restart", "disconnect")
 
 
 @dataclass

@@ -137,6 +137,8 @@ class Runner:
             cfg.base.proxy_app = nm.proxy_app
             cfg.p2p.laddr = f"127.0.0.1:{node.p2p_port}"
             cfg.rpc.laddr = f"127.0.0.1:{node.rpc_port}"
+            # perturbations drive unsafe operator routes (disconnect)
+            cfg.rpc.unsafe = True
             os.makedirs(cfg.config_dir(), exist_ok=True)
             os.makedirs(cfg.data_dir(), exist_ok=True)
             node_keys[name] = NodeKey.load_or_gen(cfg.node_key_file())
@@ -285,6 +287,10 @@ class Runner:
                     node.proc.send_signal(signal.SIGSTOP)
                     time.sleep(3.0)
                     node.proc.send_signal(signal.SIGCONT)
+                elif p == "disconnect":
+                    # perturb.go:42-72 network-disconnect analog: the
+                    # node drops all peers and quarantines redials.
+                    node.rpc("unsafe_disconnect_peers", {"duration": 3.0})
                 self._wait_recovery(node)
 
     def _wait_recovery(self, node: _Node, timeout: float = 90) -> None:

@@ -60,7 +60,12 @@ class NodeConfig:
     max_connections: int = 16
     moniker: str = "tpu-node"
     rpc_laddr: str = ""  # "host:port" enables the RPC server ("" = off)
+    rpc_unsafe: bool = False  # register unsafe operator routes
     tx_index: bool = True
+    # Event sinks (indexer/sink.py): any of "kv", "null", "sql"
+    # (reference internal/state/indexer/sink/; all configured sinks
+    # receive every block, indexer_service.go).
+    tx_index_sinks: List[str] = dc_field(default_factory=lambda: ["kv"])
     # tm-db backend selection (config/db.go:29): "memdb" or "filedb".
     # filedb requires `home` (data lands in <home>/data/*.fdb).
     db_backend: str = "memdb"
@@ -216,12 +221,48 @@ class Node:
         # publisher-blocking for the same reason (indexer_service.go).
         self.event_bus = events_mod.EventBus()
         self.indexer = None
+        self.event_sink = None
         if config.tx_index:
-            from tendermint_tpu.indexer import KVIndexer
+            from tendermint_tpu.indexer.sink import (
+                KVEventSink,
+                MultiSink,
+                NullEventSink,
+                SQLEventSink,
+            )
 
-            idx_db = open_db(config.db_backend, db_dir, "tx_index")
-            self._dbs.append(idx_db)
-            self.indexer = KVIndexer(idx_db)
+            sinks = []
+            # dedupe, order-preserving: ["kv","kv"] must not open the
+            # same store twice (the reference errors on duplicates).
+            for sink_name in dict.fromkeys(config.tx_index_sinks or ["kv"]):
+                if sink_name == "kv":
+                    from tendermint_tpu.indexer import KVIndexer
+
+                    idx_db = open_db(config.db_backend, db_dir, "tx_index")
+                    self._dbs.append(idx_db)
+                    self.indexer = KVIndexer(idx_db)
+                    sinks.append(KVEventSink(self.indexer))
+                elif sink_name == "null":
+                    sinks.append(NullEventSink())
+                elif sink_name in ("sql", "psql"):
+                    # The psql schema over stdlib sqlite3 (see
+                    # indexer/sink.py for the postgres swap).
+                    import sqlite3
+
+                    sql_path = (
+                        os.path.join(db_dir, "tx_events.sqlite")
+                        if db_dir
+                        else ":memory:"
+                    )
+                    if db_dir:
+                        os.makedirs(db_dir, exist_ok=True)
+                    conn = sqlite3.connect(sql_path, check_same_thread=False)
+                    sinks.append(SQLEventSink(conn, genesis.chain_id))
+                else:
+                    raise ValueError(
+                        f"unknown indexer sink {sink_name!r} (kv|null|sql)"
+                    )
+            if sinks:
+                self.event_sink = MultiSink(sinks)
 
         # --- observability (node.go:158-184 metrics, libs/log) ----------------
         from tendermint_tpu.libs.log import Logger
@@ -389,6 +430,8 @@ class Node:
                 get_state=lambda: self.consensus.state,
                 is_syncing=lambda: not self._caught_up_event.is_set(),
                 consensus_reactor=self.consensus_reactor,
+                router=self.router,
+                unsafe=config.rpc_unsafe,
             )
             self.rpc_env = env
             self.rpc_server = RPCServer(
@@ -556,6 +599,11 @@ class Node:
                 self._owned_signer.close()
             except Exception:
                 pass
+        if self.event_sink is not None:
+            try:
+                self.event_sink.close()
+            except Exception:
+                pass
         for db in getattr(self, "_dbs", []):
             try:
                 db.close()
@@ -566,8 +614,8 @@ class Node:
     def _fire_events(self, block, block_id, fres, validator_updates) -> None:
         """execution.go:600-648 fireEvents: publish NewBlock, header, one
         event per tx, and validator-set updates onto the bus."""
-        if self.indexer is not None:
-            self.indexer.index_finalized_block(
+        if self.event_sink is not None:
+            self.event_sink.index_finalized_block(
                 block.header.height, block.data.txs, fres
             )
         bus = self.event_bus

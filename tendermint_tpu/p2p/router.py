@@ -152,6 +152,12 @@ class Router:
                 if self._stop_flag.is_set():
                     return
                 continue
+            if self._quarantined():
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                continue
             ip = getattr(conn, "remote_ip", None)
             if ip is not None and not self._conn_tracker.add(ip):
                 self.logger.info("inbound rejected: per-IP limit", ip=ip)
@@ -165,6 +171,9 @@ class Router:
     def _dial_loop(self) -> None:
         """router.go dialPeers:528."""
         while not self._stop_flag.is_set():
+            if self._quarantined():
+                self._stop_flag.wait(0.2)
+                continue
             address = self.peer_manager.dial_next()
             if address is None:
                 self._stop_flag.wait(0.1)
@@ -316,3 +325,24 @@ class Router:
     def connected_peers(self) -> List[NodeID]:
         with self._mtx:
             return list(self._peer_conns.keys())
+
+    def disconnect_all(self, duration: float = 5.0) -> int:
+        """Drop every peer connection and refuse dial/accept for
+        ``duration`` seconds — the process-level analog of the e2e
+        runner's docker-network `disconnect` perturbation
+        (test/e2e/runner/perturb.go:42-72). Returns the number of peers
+        dropped; reconnection happens through the normal persistent-peer
+        retry path once the quarantine lapses."""
+        import time as _t
+
+        with self._mtx:
+            peers = list(self._peer_conns.keys())
+            self._quarantine_until = _t.monotonic() + duration
+        for peer_id in peers:
+            self._disconnect(peer_id)
+        return len(peers)
+
+    def _quarantined(self) -> bool:
+        import time as _t
+
+        return _t.monotonic() < getattr(self, "_quarantine_until", 0.0)

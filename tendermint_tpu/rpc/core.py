@@ -62,6 +62,8 @@ class Environment:
         get_state: Optional[Callable] = None,
         is_syncing: Optional[Callable[[], bool]] = None,
         consensus_reactor=None,
+        router=None,
+        unsafe: bool = False,
     ):
         self.node_info = node_info
         self.genesis = genesis
@@ -77,12 +79,14 @@ class Environment:
         self.get_state = get_state or (lambda: None)
         self.is_syncing = is_syncing or (lambda: False)
         self.consensus_reactor = consensus_reactor
+        self.router = router
+        self.unsafe = unsafe
 
     # -- route table ----------------------------------------------------------
 
     def routes(self) -> Dict[str, Callable]:
         """internal/rpc/core/routes.go:28-80."""
-        return {
+        routes = {
             "health": self.health,
             "status": self.status,
             "net_info": self.net_info,
@@ -115,6 +119,11 @@ class Environment:
             "genesis_chunked": self.genesis_chunked,
             "remove_tx": self.remove_tx,
         }
+        if self.unsafe:
+            # reference routes.go AddUnsafeRoutes: only registered when
+            # the operator opted in ([rpc] unsafe = true).
+            routes["unsafe_disconnect_peers"] = self.unsafe_disconnect_peers
+        return routes
 
     # -- info routes ----------------------------------------------------------
 
@@ -189,6 +198,17 @@ class Environment:
             "n_peers": str(len(peers)),
             "peers": peers,
         }
+
+    def unsafe_disconnect_peers(self, duration: float = 5.0) -> Dict[str, Any]:
+        """Drop all peer connections and quarantine dial/accept for
+        ``duration`` seconds — the process-level 'disconnect'
+        perturbation the e2e runner drives (perturb.go:42-72 network
+        disconnect analog)."""
+        if self.router is None:
+            raise RPCError(INTERNAL_ERROR, "router unavailable")
+        duration = min(max(float(duration), 0.0), 60.0)  # cap the outage
+        dropped = self.router.disconnect_all(duration)
+        return {"dropped": dropped, "duration": duration}
 
     def genesis_route(self) -> Dict[str, Any]:
         g = self.genesis

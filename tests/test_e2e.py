@@ -43,8 +43,12 @@ start_at = 7
     def test_rejects_bad_perturbation(self):
         with pytest.raises(ValueError, match="invalid perturbation"):
             Manifest.parse(
-                "[node.a]\nperturb = ['disconnect']\n"
+                "[node.a]\nperturb = ['meteor-strike']\n"
             )
+
+    def test_disconnect_perturbation_accepted(self):
+        m = Manifest.parse("[node.a]\nperturb = ['disconnect']\n")
+        assert m.nodes["a"].perturb == ["disconnect"]
 
     def test_rejects_bad_mode(self):
         with pytest.raises(ValueError, match="invalid mode"):
@@ -88,6 +92,7 @@ wait_heights = 4
 perturb = ["kill"]
 
 [node.validator2]
+perturb = ["disconnect"]
 """
         )
         events = []

@@ -192,3 +192,44 @@ def test_router_rejects_unknown_queue_type():
     with pytest.raises(ValueError):
         Router(info, PeerManager(nk.node_id), net.transport("nx"),
                queue_type="bogus")
+
+
+# --- disconnect perturbation (router quarantine) ----------------------------
+
+
+def test_disconnect_all_drops_and_reconnects():
+    """unsafe_disconnect_peers' engine: all peers drop, dial/accept stay
+    quarantined for the duration, then persistent-peer retry reconnects
+    (the e2e 'disconnect' perturbation, perturb.go:42-72 analog)."""
+    from tests.test_p2p import make_router
+    from tendermint_tpu.p2p.peermanager import PeerAddress
+    from tendermint_tpu.p2p.transport import MemoryNetwork
+
+    net = MemoryNetwork()
+    r1, nk1, pm1 = make_router(net, "dq1")
+    r2, nk2, pm2 = make_router(net, "dq2")
+    r1.open_channel(0x7E)
+    r2.open_channel(0x7E)
+    r1.start()
+    r2.start()
+    try:
+        pm1.add_address(PeerAddress(nk2.node_id, "dq2"), persistent=True)
+        deadline = time.monotonic() + 5
+        while not r1.connected_peers() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert r1.connected_peers()
+
+        dropped = r1.disconnect_all(duration=1.0)
+        assert dropped == 1
+        assert r1.connected_peers() == []
+        # still quarantined shortly after: no reconnect yet
+        time.sleep(0.3)
+        assert r1.connected_peers() == []
+        # after the quarantine lapses the persistent peer comes back
+        deadline = time.monotonic() + 20
+        while not r1.connected_peers() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert r1.connected_peers() == [nk2.node_id], "no reconnect"
+    finally:
+        r1.stop()
+        r2.stop()

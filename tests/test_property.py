@@ -1,0 +1,175 @@
+"""Property-based tests (hypothesis) — the analog of the reference's
+`rapid` usage (go.mod:36; internal/p2p/peermanager_test.go drives the
+peer manager with random op sequences).
+
+Three surfaces where random exploration pays:
+
+- the hand-rolled protobuf varint/field codec (encoding/proto.py) —
+  round-trip over the full value ranges;
+- Vote/Commit wire round-trips over randomized field contents;
+- PeerManager state-machine invariants under arbitrary interleavings of
+  add/dial/accept/ready/disconnect.
+"""
+
+import string
+
+from hypothesis import HealthCheck, given, settings, strategies as st
+
+from tendermint_tpu.encoding.proto import (
+    Reader,
+    encode_bytes_field,
+    encode_string_field,
+    encode_varint,
+    encode_varint_field,
+    encode_zigzag,
+)
+
+_slow = settings(
+    max_examples=60,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+
+
+# --- proto codec ------------------------------------------------------------
+
+
+@given(st.integers(min_value=0, max_value=2**64 - 1))
+@_slow
+def test_varint_roundtrip(n):
+    r = Reader(encode_varint(n))
+    assert r.read_varint() == n
+    assert r.eof()
+
+
+@given(st.integers(min_value=-(2**63), max_value=2**63 - 1))
+@_slow
+def test_zigzag_roundtrip(n):
+    v = Reader(encode_zigzag(n)).read_varint()
+    assert (v >> 1) ^ -(v & 1) == n
+
+
+@given(
+    st.integers(min_value=1, max_value=2**29 - 1),
+    st.binary(max_size=512),
+)
+@_slow
+def test_bytes_field_roundtrip(field_no, payload):
+    raw = encode_bytes_field(field_no, payload)
+    if not payload:
+        assert raw == b""  # proto3 default elision
+        return
+    r = Reader(raw)
+    fno, wire = r.read_tag()
+    assert fno == field_no and wire == 2
+    assert r.read_bytes() == payload
+
+
+@given(
+    st.integers(min_value=1, max_value=2**29 - 1),
+    st.text(alphabet=string.printable, max_size=200),
+)
+@_slow
+def test_string_field_roundtrip(field_no, s):
+    raw = encode_string_field(field_no, s)
+    if not s:
+        assert raw == b""
+        return
+    r = Reader(raw)
+    fno, wire = r.read_tag()
+    assert fno == field_no
+    assert r.read_bytes().decode() == s
+
+
+# --- vote wire round-trip ---------------------------------------------------
+
+
+@given(
+    type_=st.sampled_from([1, 2]),
+    height=st.integers(min_value=0, max_value=2**62),
+    round_=st.integers(min_value=0, max_value=2**31 - 1),
+    ts_ns=st.integers(
+        min_value=0, max_value=2**62
+    ),
+    addr=st.binary(min_size=20, max_size=20),
+    index=st.integers(min_value=0, max_value=2**31 - 1),
+    sig=st.binary(min_size=1, max_size=64),
+    ext=st.binary(max_size=64),
+)
+@_slow
+def test_vote_proto_roundtrip(type_, height, round_, ts_ns, addr, index, sig, ext):
+    from tendermint_tpu.encoding.canonical import Timestamp
+    from tendermint_tpu.types.block import Vote
+
+    v = Vote(
+        type=type_,
+        height=height,
+        round=round_,
+        timestamp=Timestamp.from_unix_ns(ts_ns),
+        validator_address=addr,
+        validator_index=index,
+        signature=sig,
+        extension=ext if type_ == 2 else b"",
+        extension_signature=(b"\x01" * 64 if ext and type_ == 2 else b""),
+    )
+    decoded = Vote.from_proto_bytes(v.to_proto_bytes())
+    assert decoded == v
+
+
+# --- peer manager state machine ---------------------------------------------
+
+
+@given(
+    ops=st.lists(
+        st.tuples(
+            st.sampled_from(["add", "accept", "dial", "ready", "drop"]),
+            st.integers(min_value=0, max_value=7),
+        ),
+        max_size=60,
+    ),
+    max_connected=st.integers(min_value=1, max_value=4),
+)
+@_slow
+def test_peermanager_invariants(ops, max_connected):
+    """peermanager_test.go (rapid) analog: under ANY interleaving,
+    - connected never exceeds max_connected (persistent pins aside),
+    - the self node id is never admitted,
+    - every op leaves the manager able to answer dial_next/connected."""
+    from tendermint_tpu.p2p.peermanager import PeerAddress, PeerManager
+
+    self_id = "f" * 40
+    ids = ["%040x" % i for i in range(8)]
+    pm = PeerManager(self_id, max_connected=max_connected)
+    connected = set()
+    for op, i in ops:
+        nid = ids[i]
+        if op == "add":
+            pm.add_address(PeerAddress(nid, f"host{i}:1"))
+            assert not pm.add_address(PeerAddress(self_id, "self:1"))
+        elif op == "accept":
+            try:
+                pm.accepted(nid)
+                connected.add(nid)
+            except Exception:
+                pass
+        elif op == "dial":
+            addr = pm.dial_next()
+            if addr is not None:
+                assert addr.node_id != self_id
+                try:
+                    pm.dialed(addr)
+                    connected.add(addr.node_id)
+                except Exception:
+                    pass
+        elif op == "ready":
+            if nid in connected:
+                pm.ready(nid)
+        elif op == "drop":
+            if nid in connected:
+                pm.disconnected(nid)
+                connected.discard(nid)
+        assert self_id not in pm.connected_peers()
+        assert len(pm.connected_peers()) <= max_connected + 1  # persistent slack
+    # the manager still serves queries after the op storm
+    pm.dial_next()
+    pm.connected_peers()
