@@ -622,8 +622,35 @@ def cmd_reindex_event(args) -> int:
         for f in os.listdir(cfg.data_dir()):
             if f.startswith("tx_index"):
                 os.unlink(os.path.join(cfg.data_dir(), f))
-    idx_db = open_db(cfg.base.db_backend, cfg.data_dir(), "tx_index")
-    indexer = KVIndexer(idx_db)
+    from tendermint_tpu.indexer.sink import KVEventSink, MultiSink, SQLEventSink
+
+    # Rebuild EVERY configured sink, not just kv — the live node and the
+    # offline rebuild share the sink entry point so they cannot diverge.
+    sink_names = [
+        "sql" if s == "psql" else s for s in (cfg.indexer.sinks or ["kv"])
+    ]
+    sinks = []
+    idx_db = None
+    if "kv" in sink_names:
+        idx_db = open_db(cfg.base.db_backend, cfg.data_dir(), "tx_index")
+        sinks.append(KVEventSink(KVIndexer(idx_db)))
+    if "sql" in sink_names:
+        import sqlite3
+
+        sql_path = os.path.join(cfg.data_dir(), "tx_events.sqlite")
+        if os.path.exists(sql_path):
+            os.unlink(sql_path)  # rebuild from scratch, as with kv
+        chain_id = ""
+        try:
+            from tendermint_tpu.types.genesis import GenesisDoc
+
+            chain_id = GenesisDoc.from_file(cfg.genesis_file()).chain_id
+        except Exception:
+            pass
+        sinks.append(
+            SQLEventSink(sqlite3.connect(sql_path), chain_id or "unknown")
+        )
+    sink = MultiSink(sinks)
     base = max(block_store.base(), 1)
     height = block_store.height()
     indexed_blocks = indexed_txs = skipped = 0
@@ -635,10 +662,12 @@ def cmd_reindex_event(args) -> int:
             continue
         # same single entry point the live node writes through, so the
         # rebuilt index is byte-identical to what the node would produce
-        indexer.index_finalized_block(h, block.data.txs, fres)
+        sink.index_finalized_block(h, block.data.txs, fres)
         indexed_blocks += 1
         indexed_txs += min(len(fres.tx_results), len(block.data.txs))
-    idx_db.close()
+    sink.close()
+    if idx_db is not None:
+        idx_db.close()
     print(
         f"reindexed {indexed_blocks} blocks, {indexed_txs} txs "
         f"({skipped} heights skipped: block or responses pruned)"

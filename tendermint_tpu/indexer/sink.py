@@ -138,7 +138,18 @@ class SQLEventSink(EventSink):
     def index_finalized_block(self, height: int, txs, fres) -> None:
         """One transaction per block: block row, block events, tx rows,
         tx events — psql.go IndexBlockEvents + IndexTxEvents fused, as
-        in the kv sink."""
+        in the kv sink. A failure mid-block ROLLS BACK, so a later
+        block's commit can never publish this block's partial rows."""
+        try:
+            self._index_block(height, txs, fres)
+        except Exception:
+            try:
+                self._conn.rollback()
+            except Exception:
+                pass
+            raise
+
+    def _index_block(self, height: int, txs, fres) -> None:
         import hashlib
 
         cur = self._conn.cursor()

@@ -231,9 +231,14 @@ class Node:
             )
 
             sinks = []
-            # dedupe, order-preserving: ["kv","kv"] must not open the
-            # same store twice (the reference errors on duplicates).
-            for sink_name in dict.fromkeys(config.tx_index_sinks or ["kv"]):
+            # dedupe AFTER normalizing aliases ("psql" == "sql"):
+            # duplicates must not open the same store twice (the
+            # reference errors on duplicates).
+            normalized = [
+                "sql" if s == "psql" else s
+                for s in (config.tx_index_sinks or ["kv"])
+            ]
+            for sink_name in dict.fromkeys(normalized):
                 if sink_name == "kv":
                     from tendermint_tpu.indexer import KVIndexer
 
@@ -243,7 +248,7 @@ class Node:
                     sinks.append(KVEventSink(self.indexer))
                 elif sink_name == "null":
                     sinks.append(NullEventSink())
-                elif sink_name in ("sql", "psql"):
+                elif sink_name == "sql":
                     # The psql schema over stdlib sqlite3 (see
                     # indexer/sink.py for the postgres swap).
                     import sqlite3

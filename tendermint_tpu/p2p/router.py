@@ -215,6 +215,14 @@ class Router:
                     self._conn_tracker.remove(ip)
             conn.close()
             return
+        if self._quarantined():
+            # disconnect_all fired while this handshake was in flight: a
+            # peer must not install itself during the quarantine.
+            ip = getattr(conn, "remote_ip", None)
+            if dialed is None and ip is not None:
+                self._conn_tracker.remove(ip)
+            conn.close()
+            return
         peer_id = peer_info.node_id
         from tendermint_tpu.p2p.pqueue import make_send_queue
 

@@ -120,9 +120,15 @@ class Environment:
             "remove_tx": self.remove_tx,
         }
         if self.unsafe:
-            # reference routes.go AddUnsafeRoutes: only registered when
-            # the operator opted in ([rpc] unsafe = true).
+            # Reference routes.go AddUnsafeRoutes / the pprof server
+            # behind PprofListenAddress (node.go OnStart): the whole
+            # diagnostic+operator surface requires the explicit
+            # [rpc] unsafe opt-in — thread dumps leak peer identities
+            # (router-send-<peer> thread names) to whoever can ask.
+            routes["dump_routines"] = self.dump_routines
             routes["unsafe_disconnect_peers"] = self.unsafe_disconnect_peers
+            routes["unsafe_start_profiler"] = self.unsafe_start_profiler
+            routes["unsafe_stop_profiler"] = self.unsafe_stop_profiler
         return routes
 
     # -- info routes ----------------------------------------------------------
@@ -209,6 +215,59 @@ class Environment:
         duration = min(max(float(duration), 0.0), 60.0)  # cap the outage
         dropped = self.router.disconnect_all(duration)
         return {"dropped": dropped, "duration": duration}
+
+    def dump_routines(self) -> Dict[str, Any]:
+        """Per-thread stack traces — the goroutine-dump half of the
+        reference's pprof endpoint (node.go pprof server; read-only)."""
+        import sys
+        import traceback
+
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        routines = []
+        for ident, frame in frames.items():
+            routines.append(
+                {
+                    "thread": names.get(ident, str(ident)),
+                    "stack": traceback.format_stack(frame),
+                }
+            )
+        return {"count": len(routines), "routines": routines}
+
+    # cProfile hooks the whole interpreter, so the session is process-
+    # wide by nature; the lock serializes the check-then-set against
+    # concurrent RPCs (and multiple in-process nodes).
+    _profiler = None
+    _profiler_mtx = threading.Lock()
+
+    def unsafe_start_profiler(self) -> Dict[str, Any]:
+        """Start a process-wide cProfile session (the CPU-profile half of
+        the reference's pprof surface; unsafe opt-in)."""
+        import cProfile
+
+        with Environment._profiler_mtx:
+            if Environment._profiler is not None:
+                raise RPCError(INTERNAL_ERROR, "profiler already running")
+            prof = cProfile.Profile()
+            Environment._profiler = prof
+            prof.enable()
+        return {"started": True}
+
+    def unsafe_stop_profiler(self, top: int = 40) -> Dict[str, Any]:
+        import io
+        import pstats
+
+        with Environment._profiler_mtx:
+            prof = Environment._profiler
+            if prof is None:
+                raise RPCError(INTERNAL_ERROR, "profiler not running")
+            prof.disable()
+            Environment._profiler = None
+        buf = io.StringIO()
+        pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(
+            int(top)
+        )
+        return {"stats": buf.getvalue()}
 
     def genesis_route(self) -> Dict[str, Any]:
         g = self.genesis

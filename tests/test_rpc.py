@@ -327,3 +327,50 @@ class TestLightHTTPProvider:
         prov = HTTPProvider(CHAIN, node.rpc_server.url)
         with pytest.raises(ProviderError):
             prov.light_block(10_000_000)
+
+
+class TestProfilingRoutes:
+    """The pprof-analog surface (node.go pprof server): thread dumps
+    always-on, CPU profiler behind the unsafe opt-in."""
+
+    @pytest.fixture()
+    def env(self):
+        from tendermint_tpu.rpc.core import Environment
+
+        return Environment()
+
+    def test_dump_routines_lists_threads(self, env):
+        out = env.dump_routines()
+        assert out["count"] >= 1
+        names = [r["thread"] for r in out["routines"]]
+        assert any("MainThread" in n for n in names)
+        assert all(isinstance(r["stack"], list) for r in out["routines"])
+
+    def test_profiler_roundtrip(self, env):
+        env.unsafe_start_profiler()
+        sum(i * i for i in range(50_000))  # some work to sample
+        out = env.unsafe_stop_profiler(top=5)
+        assert "cumulative" in out["stats"] or "function calls" in out["stats"]
+
+    def test_profiler_double_start_rejected(self, env):
+        from tendermint_tpu.rpc.server import RPCError
+
+        env.unsafe_start_profiler()
+        try:
+            with pytest.raises(RPCError):
+                env.unsafe_start_profiler()
+        finally:
+            env.unsafe_stop_profiler()
+
+    def test_unsafe_routes_gated(self, env):
+        routes_safe = env.routes()
+        # the whole diagnostic surface (thread dumps leak peer thread
+        # names) requires the [rpc] unsafe opt-in
+        assert "dump_routines" not in routes_safe
+        assert "unsafe_start_profiler" not in routes_safe
+        env.unsafe = True
+        routes_unsafe = env.routes()
+        assert "dump_routines" in routes_unsafe
+        assert "unsafe_start_profiler" in routes_unsafe
+        assert "unsafe_disconnect_peers" in routes_unsafe
+        env.unsafe = False
