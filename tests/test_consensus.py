@@ -619,3 +619,47 @@ class TestDoubleSignRiskGuard:
         )
         cs2.start()  # must NOT raise: no own signature in the window
         cs2.stop()
+
+
+class TestRoundSkipping:
+    def test_two_thirds_any_at_future_round_skips_forward(self, tmp_path):
+        """Liveness rule (state.go addVote): +2/3 of prevotes at ANY
+        value in a FUTURE round pulls a lagging validator straight to
+        that round instead of grinding through timeouts round by
+        round."""
+        from tendermint_tpu.encoding.canonical import (
+            SIGNED_MSG_TYPE_PREVOTE as PV,
+        )
+        from tendermint_tpu.types.block import BlockID as BID
+
+        h = LockHarness(tmp_path, subject_is_proposer=False)
+        h.cs.start()
+        try:
+            # the rest of the network is already at round 5
+            h.inject_votes(PV, 5, BID())
+            assert _wait(lambda: h.cs.rs.round == 5, timeout=20), (
+                f"stuck at round {h.cs.rs.round}"
+            )
+            # and it participates there: a prevote at round 5 (nil if
+            # no proposal, or its own block when rotation makes it the
+            # round-5 proposer)
+            pv5 = _wait(lambda: _vote_of(h.cap, PV, 5), timeout=20)
+            assert pv5 is not None
+        finally:
+            h.cs.stop()
+
+    def test_future_round_precommits_skip_too(self, tmp_path):
+        from tendermint_tpu.encoding.canonical import (
+            SIGNED_MSG_TYPE_PRECOMMIT as PC,
+        )
+        from tendermint_tpu.types.block import BlockID as BID
+
+        h = LockHarness(tmp_path, subject_is_proposer=False)
+        h.cs.start()
+        try:
+            h.inject_votes(PC, 3, BID())
+            assert _wait(lambda: h.cs.rs.round >= 3, timeout=20), (
+                f"stuck at round {h.cs.rs.round}"
+            )
+        finally:
+            h.cs.stop()

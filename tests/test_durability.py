@@ -179,3 +179,54 @@ class TestRollback:
         block_store = BlockStore(open_db("memdb"))
         with pytest.raises(ValueError):
             rollback_state(state_store, block_store)
+
+
+class TestWALCorruption:
+    """wal_test.go territory: a crash can tear the final record or leave
+    garbage at the WAL head; restart must truncate and continue, never
+    wedge or double-sign."""
+
+    def _wal_head(self, home):
+        import os
+
+        head = os.path.join(home, "cs.wal")  # autofile head (node.py:359)
+        assert os.path.exists(head), f"no WAL head at {head}"
+        return head
+
+    def test_torn_tail_truncated_on_restart(self, home):
+        node, _ = make_node(home)
+        node.start()
+        try:
+            _run_to_height(node, 3)
+            h_before = node.height
+        finally:
+            _hard_stop(node)
+        head = self._wal_head(home)
+        with open(head, "ab") as f:
+            f.write(b"\x00\x00\x00\x09\x00\x00\x00\xff" + b"torn")  # partial record
+        node2, _ = make_node(home)
+        node2.start()
+        try:
+            _run_to_height(node2, h_before + 2)
+        finally:
+            _hard_stop(node2)
+
+    def test_garbage_tail_truncated_on_restart(self, home):
+        node, _ = make_node(home)
+        node.start()
+        try:
+            _run_to_height(node, 3)
+            h_before = node.height
+        finally:
+            _hard_stop(node)
+        head = self._wal_head(home)
+        import os as _os
+
+        with open(head, "ab") as f:
+            f.write(_os.urandom(512))  # random bytes, bad CRC framing
+        node2, _ = make_node(home)
+        node2.start()
+        try:
+            _run_to_height(node2, h_before + 2)
+        finally:
+            _hard_stop(node2)
