@@ -19,9 +19,11 @@ A manifest is a TOML document: one ``[testnet]`` table plus a
 
 Node options mirror the reference manifest knobs that apply here:
 mode (validator|full), start_at, db_backend, perturb list
-(kill|pause|restart — disconnect needs packet-level control the harness
-doesn't have), proxy_app (kvstore|persistent_kvstore), and
-privval ("file" | "remote" for an out-of-process signer).
+(kill|pause|restart|disconnect — disconnect drives the node's gated
+unsafe_disconnect_peers route), proxy_app (kvstore|persistent_kvstore,
+or "tcp"/"grpc" for an out-of-process app the runner spawns behind the
+matching ABCI transport), and privval ("file" | "remote" for an
+out-of-process signer).
 """
 
 from __future__ import annotations
@@ -32,6 +34,7 @@ from typing import Dict, List
 
 VALID_MODES = ("validator", "full")
 VALID_PERTURBATIONS = ("kill", "pause", "restart", "disconnect")
+VALID_PROXY_APPS = ("kvstore", "persistent_kvstore", "tcp", "grpc")
 
 
 @dataclass
@@ -55,6 +58,11 @@ class NodeManifest:
                 )
         if self.start_at < 0:
             raise ValueError(f"node {self.name}: negative start_at")
+        if self.proxy_app not in VALID_PROXY_APPS:
+            raise ValueError(
+                f"node {self.name}: invalid proxy_app {self.proxy_app!r} "
+                f"(valid: {VALID_PROXY_APPS})"
+            )
         if self.privval not in ("file", "remote"):
             raise ValueError(
                 f"node {self.name}: invalid privval {self.privval!r}"

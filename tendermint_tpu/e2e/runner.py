@@ -53,6 +53,11 @@ class _Node:
     rpc_port: int
     proc: Optional[subprocess.Popen] = None
     log_path: str = ""
+    # out-of-process ABCI app (proxy_app = "tcp" | "grpc"): its port and
+    # process; the app outlives node kill/restart perturbations, like a
+    # real deployment's app container.
+    app_port: int = 0
+    app_proc: Optional[subprocess.Popen] = None
 
     @property
     def rpc_url(self) -> str:
@@ -119,7 +124,7 @@ class Runner:
         from tendermint_tpu.types.params import ConsensusParams, TimeoutParams
 
         names = list(self.manifest.nodes)
-        ports = _free_ports(2 * len(names))
+        ports = _free_ports(3 * len(names))
         pvs, node_keys = {}, {}
         for i, name in enumerate(names):
             nm = self.manifest.nodes[name]
@@ -127,14 +132,21 @@ class Runner:
             node = _Node(
                 manifest=nm,
                 home=home,
-                p2p_port=ports[2 * i],
-                rpc_port=ports[2 * i + 1],
+                p2p_port=ports[3 * i],
+                rpc_port=ports[3 * i + 1],
                 log_path=os.path.join(self.workdir, f"{name}.log"),
             )
             cfg = Config(home=home)
             cfg.base.moniker = name
             cfg.base.db_backend = nm.db_backend
-            cfg.base.proxy_app = nm.proxy_app
+            if nm.proxy_app in ("tcp", "grpc"):
+                # out-of-process app behind the matching ABCI transport
+                node.app_port = ports[3 * i + 2]
+                cfg.base.proxy_app = (
+                    f"{nm.proxy_app}://127.0.0.1:{node.app_port}"
+                )
+            else:
+                cfg.base.proxy_app = nm.proxy_app
             cfg.p2p.laddr = f"127.0.0.1:{node.p2p_port}"
             cfg.rpc.laddr = f"127.0.0.1:{node.rpc_port}"
             # perturbations drive unsafe operator routes (disconnect)
@@ -179,21 +191,62 @@ class Runner:
 
     # --- start/stop ----------------------------------------------------------
 
-    def _spawn(self, node: _Node) -> None:
-        log_fh = open(node.log_path, "ab")
-        node.proc = subprocess.Popen(
-            [
-                sys.executable,
-                "-m",
-                "tendermint_tpu",
-                "--home",
-                node.home,
-                "start",
-            ],
-            cwd=REPO_ROOT,
-            stdout=log_fh,
-            stderr=subprocess.STDOUT,
+    def _ensure_app(self, node: _Node) -> None:
+        """Spawn (or respawn) the node's out-of-process ABCI app and
+        wait until it accepts connections — the node's client probes at
+        startup and must not race the app's bind."""
+        if node.app_port == 0:
+            return
+        if node.app_proc is not None and node.app_proc.poll() is None:
+            return
+        with open(node.log_path, "ab") as log_fh:
+            # the child inherits the fd; the parent copy closes right away
+            node.app_proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "tendermint_tpu.abci.socket_server",
+                    "--transport",
+                    "grpc" if node.manifest.proxy_app == "grpc" else "socket",
+                    "--addr", f"127.0.0.1:{node.app_port}",
+                ],
+                cwd=REPO_ROOT,
+                stdout=log_fh,
+                stderr=subprocess.STDOUT,
+            )
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            rc = node.app_proc.poll()
+            if rc is not None:
+                raise E2EError(
+                    f"{node.manifest.name}: abci app exited rc={rc} before "
+                    f"binding :{node.app_port} (log: {node.log_path})"
+                )
+            try:
+                socket.create_connection(
+                    ("127.0.0.1", node.app_port), timeout=1
+                ).close()
+                return
+            except OSError:
+                time.sleep(0.2)
+        raise E2EError(
+            f"{node.manifest.name}: abci app never bound :{node.app_port}"
         )
+
+    def _spawn(self, node: _Node) -> None:
+        self._ensure_app(node)
+        with open(node.log_path, "ab") as log_fh:
+            node.proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "tendermint_tpu",
+                    "--home",
+                    node.home,
+                    "start",
+                ],
+                cwd=REPO_ROOT,
+                stdout=log_fh,
+                stderr=subprocess.STDOUT,
+            )
 
     def start(self) -> None:
         """Start genesis nodes; late joiners start in wait()."""
@@ -230,6 +283,13 @@ class Runner:
                     node.proc.wait(timeout=15)
                 except subprocess.TimeoutExpired:
                     node.proc.kill()
+        for node in self.nodes.values():
+            if node.app_proc is not None and node.app_proc.poll() is None:
+                node.app_proc.kill()
+                try:
+                    node.app_proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
 
     # --- load ----------------------------------------------------------------
 

@@ -103,3 +103,33 @@ perturb = ["disconnect"]
         assert "recovered" in joined
         assert "invariants ok" in joined
         assert not runner.failures
+
+
+class TestExternalAppTransports:
+    def test_testnet_with_grpc_and_socket_apps(self, tmp_path):
+        """A 3-validator testnet where one node's app is out-of-process
+        behind the gRPC transport and another behind the socket
+        transport — the runner spawns and supervises the app processes
+        and consensus proceeds across all three."""
+        manifest = Manifest.parse(
+            """
+[testnet]
+chain_id = "e2e-transports"
+load_tx_per_sec = 2.0
+wait_heights = 3
+
+[node.validator0]
+
+[node.validator1]
+proxy_app = "grpc"
+
+[node.validator2]
+proxy_app = "tcp"
+"""
+        )
+        events = []
+        runner = Runner(manifest, str(tmp_path), log=events.append)
+        runner.run()
+        joined = "\n".join(events)
+        assert "invariants ok" in joined
+        assert not runner.failures
