@@ -22,8 +22,9 @@ mode (validator|full), start_at, db_backend, perturb list
 (kill|pause|restart|disconnect — disconnect drives the node's gated
 unsafe_disconnect_peers route), proxy_app (kvstore|persistent_kvstore,
 or "tcp"/"grpc" for an out-of-process app the runner spawns behind the
-matching ABCI transport), and privval ("file" | "remote" for an
-out-of-process signer).
+matching ABCI transport), and privval ("file", or "remote"/"grpc" for
+an out-of-process signer — socket flavor dials the node, grpc flavor
+serves and the node dials).
 """
 
 from __future__ import annotations
@@ -63,9 +64,10 @@ class NodeManifest:
                 f"node {self.name}: invalid proxy_app {self.proxy_app!r} "
                 f"(valid: {VALID_PROXY_APPS})"
             )
-        if self.privval not in ("file", "remote"):
+        if self.privval not in ("file", "remote", "grpc"):
             raise ValueError(
-                f"node {self.name}: invalid privval {self.privval!r}"
+                f"node {self.name}: invalid privval {self.privval!r} "
+                "(valid: file | remote | grpc)"
             )
 
 

@@ -58,6 +58,10 @@ class _Node:
     # real deployment's app container.
     app_port: int = 0
     app_proc: Optional[subprocess.Popen] = None
+    # out-of-process signer (privval = "remote" | "grpc"); also outlives
+    # node perturbations (the socket flavor redials forever).
+    signer_port: int = 0
+    signer_proc: Optional[subprocess.Popen] = None
 
     @property
     def rpc_url(self) -> str:
@@ -124,7 +128,7 @@ class Runner:
         from tendermint_tpu.types.params import ConsensusParams, TimeoutParams
 
         names = list(self.manifest.nodes)
-        ports = _free_ports(3 * len(names))
+        ports = _free_ports(4 * len(names))
         pvs, node_keys = {}, {}
         for i, name in enumerate(names):
             nm = self.manifest.nodes[name]
@@ -132,8 +136,8 @@ class Runner:
             node = _Node(
                 manifest=nm,
                 home=home,
-                p2p_port=ports[3 * i],
-                rpc_port=ports[3 * i + 1],
+                p2p_port=ports[4 * i],
+                rpc_port=ports[4 * i + 1],
                 log_path=os.path.join(self.workdir, f"{name}.log"),
             )
             cfg = Config(home=home)
@@ -141,12 +145,22 @@ class Runner:
             cfg.base.db_backend = nm.db_backend
             if nm.proxy_app in ("tcp", "grpc"):
                 # out-of-process app behind the matching ABCI transport
-                node.app_port = ports[3 * i + 2]
+                node.app_port = ports[4 * i + 2]
                 cfg.base.proxy_app = (
                     f"{nm.proxy_app}://127.0.0.1:{node.app_port}"
                 )
             else:
                 cfg.base.proxy_app = nm.proxy_app
+            if nm.privval in ("remote", "grpc"):
+                # out-of-process signer: socket flavor = node listens,
+                # signer dials in; grpc flavor = signer serves, node
+                # dials (privval/grpc direction).
+                node.signer_port = ports[4 * i + 3]
+                cfg.privval.laddr = (
+                    f"grpc://127.0.0.1:{node.signer_port}"
+                    if nm.privval == "grpc"
+                    else f"tcp://127.0.0.1:{node.signer_port}"
+                )
             cfg.p2p.laddr = f"127.0.0.1:{node.p2p_port}"
             cfg.rpc.laddr = f"127.0.0.1:{node.rpc_port}"
             # perturbations drive unsafe operator routes (disconnect)
@@ -191,6 +205,24 @@ class Runner:
 
     # --- start/stop ----------------------------------------------------------
 
+    def _wait_bound(self, proc, port: int, what: str, log_path: str) -> None:
+        """Wait for a helper process to accept connections, failing fast
+        with its exit code if it died first."""
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            rc = proc.poll()
+            if rc is not None:
+                raise E2EError(
+                    f"{what} exited rc={rc} before binding :{port} "
+                    f"(log: {log_path})"
+                )
+            try:
+                socket.create_connection(("127.0.0.1", port), timeout=1).close()
+                return
+            except OSError:
+                time.sleep(0.2)
+        raise E2EError(f"{what} never bound :{port} (log: {log_path})")
+
     def _ensure_app(self, node: _Node) -> None:
         """Spawn (or respawn) the node's out-of-process ABCI app and
         wait until it accepts connections — the node's client probes at
@@ -212,27 +244,62 @@ class Runner:
                 stdout=log_fh,
                 stderr=subprocess.STDOUT,
             )
-        deadline = time.monotonic() + 20
-        while time.monotonic() < deadline:
-            rc = node.app_proc.poll()
+        self._wait_bound(
+            node.app_proc, node.app_port,
+            f"{node.manifest.name} abci app", node.log_path,
+        )
+
+    def _ensure_signer(self, node: _Node) -> None:
+        """Spawn (or respawn) the node's out-of-process signer. The
+        socket flavor dials the node and retries forever, so spawn order
+        does not matter; the grpc flavor must be serving before the node
+        dials (the node grants signer_connect_timeout grace)."""
+        if node.signer_port == 0:
+            return
+        if node.signer_proc is not None and node.signer_proc.poll() is None:
+            return
+        flavor = node.manifest.privval
+        if flavor == "grpc":
+            mod = "tendermint_tpu.privval.grpc"
+        else:
+            mod = "tendermint_tpu.privval.remote"
+        cfg = node._cfg  # type: ignore[attr-defined]
+        addr = (
+            f"127.0.0.1:{node.signer_port}"
+            if flavor == "grpc"
+            else f"tcp://127.0.0.1:{node.signer_port}"
+        )
+        with open(node.log_path, "ab") as log_fh:
+            node.signer_proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", mod,
+                    "--addr", addr,
+                    "--chain-id", self.manifest.chain_id,
+                    "--key-file", cfg.privval_key_file(),
+                    "--state-file", cfg.privval_state_file(),
+                ],
+                cwd=REPO_ROOT,
+                stdout=log_fh,
+                stderr=subprocess.STDOUT,
+            )
+        if flavor == "grpc":
+            self._wait_bound(
+                node.signer_proc, node.signer_port,
+                f"{node.manifest.name} signer", node.log_path,
+            )
+        else:
+            # the dialing signer binds nothing; still catch instant death
+            time.sleep(0.3)
+            rc = node.signer_proc.poll()
             if rc is not None:
                 raise E2EError(
-                    f"{node.manifest.name}: abci app exited rc={rc} before "
-                    f"binding :{node.app_port} (log: {node.log_path})"
+                    f"{node.manifest.name} signer exited rc={rc} at spawn "
+                    f"(log: {node.log_path})"
                 )
-            try:
-                socket.create_connection(
-                    ("127.0.0.1", node.app_port), timeout=1
-                ).close()
-                return
-            except OSError:
-                time.sleep(0.2)
-        raise E2EError(
-            f"{node.manifest.name}: abci app never bound :{node.app_port}"
-        )
 
     def _spawn(self, node: _Node) -> None:
         self._ensure_app(node)
+        self._ensure_signer(node)
         with open(node.log_path, "ab") as log_fh:
             node.proc = subprocess.Popen(
                 [
@@ -258,7 +325,7 @@ class Runner:
             [n for n in self.nodes.values() if n.manifest.start_at == 0]
         )
 
-    def _wait_all_up(self, nodes: List[_Node], timeout: float = 60) -> None:
+    def _wait_all_up(self, nodes: List[_Node], timeout: float = 120) -> None:
         deadline = time.monotonic() + timeout
         for node in nodes:
             while True:
@@ -284,12 +351,13 @@ class Runner:
                 except subprocess.TimeoutExpired:
                     node.proc.kill()
         for node in self.nodes.values():
-            if node.app_proc is not None and node.app_proc.poll() is None:
-                node.app_proc.kill()
-                try:
-                    node.app_proc.wait(timeout=5)
-                except subprocess.TimeoutExpired:
-                    pass
+            for helper in (node.app_proc, node.signer_proc):
+                if helper is not None and helper.poll() is None:
+                    helper.kill()
+                    try:
+                        helper.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        pass
 
     # --- load ----------------------------------------------------------------
 

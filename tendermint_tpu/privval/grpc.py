@@ -183,3 +183,43 @@ class GrpcSignerServer:
             ).encode()
         except Exception as exc:
             return json.dumps({"error": str(exc)}).encode()
+
+
+def main(argv=None) -> int:
+    """Run a serving gRPC signer around a FilePV (the node dials us —
+    privval/grpc/server.go's process shape)."""
+    import argparse
+
+    from tendermint_tpu.privval.file_pv import FilePV
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tendermint_tpu.privval.grpc",
+        description="out-of-process validator signer (gRPC server; node dials)",
+    )
+    ap.add_argument("--addr", required=True, help="host:port to serve on")
+    ap.add_argument("--chain-id", required=True)
+    ap.add_argument("--key-file", required=True)
+    ap.add_argument("--state-file", required=True)
+    args = ap.parse_args(argv)
+
+    pv = FilePV.load_or_generate(args.key_file, args.state_file)
+    host, _, port = args.addr.rpartition(":")
+    server = GrpcSignerServer(
+        pv, args.chain_id, host or "127.0.0.1", int(port)
+    )
+    server.start()
+    print(
+        f"grpc signer serving on {server.address[0]}:{server.address[1]}",
+        flush=True,
+    )
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -133,3 +133,34 @@ proxy_app = "tcp"
         joined = "\n".join(events)
         assert "invariants ok" in joined
         assert not runner.failures
+
+
+class TestExternalSigners:
+    def test_testnet_with_remote_and_grpc_signers(self, tmp_path):
+        """One validator's key lives in a dialing socket signer process,
+        another's in a serving gRPC signer process; the runner spawns
+        and supervises both and consensus proceeds, including across a
+        kill of the remote-signed node (the signer redials)."""
+        manifest = Manifest.parse(
+            """
+[testnet]
+chain_id = "e2e-signers"
+load_tx_per_sec = 2.0
+wait_heights = 3
+
+[node.validator0]
+
+[node.validator1]
+privval = "remote"
+perturb = ["kill"]
+
+[node.validator2]
+privval = "grpc"
+"""
+        )
+        events = []
+        runner = Runner(manifest, str(tmp_path), log=events.append)
+        runner.run()
+        joined = "\n".join(events)
+        assert "invariants ok" in joined
+        assert not runner.failures
