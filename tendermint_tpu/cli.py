@@ -92,14 +92,18 @@ def _make_app_client(cfg: Config):
     from tendermint_tpu.abci.kvstore import KVStoreApplication
 
     spec = cfg.base.proxy_app
+    snap = cfg.base.app_snapshot_interval
     if spec == "kvstore":
-        return LocalClient(KVStoreApplication())
+        return LocalClient(KVStoreApplication(snapshot_interval=snap))
     if spec == "persistent_kvstore":
         from tendermint_tpu.storage import open_db
 
         os.makedirs(cfg.data_dir(), exist_ok=True)
         return LocalClient(
-            KVStoreApplication(db=open_db("filedb", cfg.data_dir(), "app"))
+            KVStoreApplication(
+                db=open_db("filedb", cfg.data_dir(), "app"),
+                snapshot_interval=snap,
+            )
         )
     if spec.startswith("tcp://"):
         from tendermint_tpu.abci.socket_client import SocketClient

@@ -50,6 +50,9 @@ class BaseConfig:
     db_backend: str = "filedb"
     blocksync: bool = True
     wal_enabled: bool = True
+    # Snapshot cadence of the BUILT-IN kvstore apps (state-sync
+    # providers); out-of-process apps configure their own.
+    app_snapshot_interval: int = 0
 
 
 @dataclass

@@ -47,6 +47,12 @@ class NodeManifest:
     proxy_app: str = "kvstore"
     privval: str = "file"
     perturb: List[str] = field(default_factory=list)
+    # State-sync join (late nodes only): snapshot restore + backfill
+    # instead of block-syncing the whole gap; the runner resolves the
+    # light-client trust anchor from a running node at join time.
+    statesync: bool = False
+    # Snapshot cadence of this node's app (providers need > 0).
+    snapshot_interval: int = 0
 
     def validate(self) -> None:
         if self.mode not in VALID_MODES:
@@ -64,6 +70,13 @@ class NodeManifest:
                 f"node {self.name}: invalid proxy_app {self.proxy_app!r} "
                 f"(valid: {VALID_PROXY_APPS})"
             )
+        if self.statesync and self.start_at <= 0:
+            raise ValueError(
+                f"node {self.name}: statesync requires start_at > 0 "
+                "(a running chain to snapshot from)"
+            )
+        if self.snapshot_interval < 0:
+            raise ValueError(f"node {self.name}: negative snapshot_interval")
         if self.privval not in ("file", "remote", "grpc"):
             raise ValueError(
                 f"node {self.name}: invalid privval {self.privval!r} "
@@ -98,6 +111,8 @@ class Manifest:
                 "proxy_app",
                 "privval",
                 "perturb",
+                "statesync",
+                "snapshot_interval",
             ):
                 if key in spec:
                     setattr(nm, key, spec[key])
@@ -107,6 +122,13 @@ class Manifest:
             raise ValueError("manifest has no nodes")
         if not any(n.mode == "validator" for n in m.nodes.values()):
             raise ValueError("manifest needs at least one validator")
+        if any(n.statesync for n in m.nodes.values()) and not any(
+            n.snapshot_interval > 0 for n in m.nodes.values()
+        ):
+            raise ValueError(
+                "a statesync node requires some node with "
+                "snapshot_interval > 0 (nothing would serve snapshots)"
+            )
         return m
 
     @classmethod

@@ -107,6 +107,22 @@ def _free_ports(n: int) -> List[int]:
     return ports
 
 
+def _child_env() -> dict:
+    """Hermetic environment for node/app/signer subprocesses: the shared
+    accelerator-hook immunity policy (__graft_entry__.hook_free_cpu_env
+    — drops only sitecustomize-bearing PYTHONPATH entries, keeps the
+    rest, pins CPU). The e2e harness is a correctness harness: its
+    children always run CPU."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry_for_e2e", os.path.join(REPO_ROOT, "__graft_entry__.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.hook_free_cpu_env()
+
+
 class Runner:
     def __init__(self, manifest: Manifest, workdir: str, log=print):
         self.manifest = manifest
@@ -151,6 +167,7 @@ class Runner:
                 )
             else:
                 cfg.base.proxy_app = nm.proxy_app
+            cfg.base.app_snapshot_interval = nm.snapshot_interval
             if nm.privval in ("remote", "grpc"):
                 # out-of-process signer: socket flavor = node listens,
                 # signer dials in; grpc flavor = signer serves, node
@@ -239,8 +256,11 @@ class Runner:
                     "--transport",
                     "grpc" if node.manifest.proxy_app == "grpc" else "socket",
                     "--addr", f"127.0.0.1:{node.app_port}",
+                    "--snapshot-interval",
+                    str(node.manifest.snapshot_interval),
                 ],
                 cwd=REPO_ROOT,
+                env=_child_env(),
                 stdout=log_fh,
                 stderr=subprocess.STDOUT,
             )
@@ -279,6 +299,7 @@ class Runner:
                     "--state-file", cfg.privval_state_file(),
                 ],
                 cwd=REPO_ROOT,
+                env=_child_env(),
                 stdout=log_fh,
                 stderr=subprocess.STDOUT,
             )
@@ -311,6 +332,7 @@ class Runner:
                     "start",
                 ],
                 cwd=REPO_ROOT,
+                env=_child_env(),
                 stdout=log_fh,
                 stderr=subprocess.STDOUT,
             )
@@ -443,6 +465,10 @@ class Runner:
     def wait(self, timeout: float = 180) -> None:
         """Every node reaches start height + wait_heights; late joiners
         start once the chain passes their start_at and must catch up."""
+        if any(n.manifest.statesync for n in self.nodes.values()):
+            # snapshot discovery + chunk restore + backfill + catch-up
+            # is the longest join path; give it room on loaded machines
+            timeout = max(timeout, 300)
         running = [
             n for n in self.nodes.values() if n.manifest.start_at == 0
         ]
@@ -465,9 +491,12 @@ class Runner:
                     node.manifest.name not in started_late
                     and chain_h >= node.manifest.start_at
                 ):
+                    if node.manifest.statesync:
+                        self._arm_statesync(node, running)
                     self.log(
                         f"start: late joiner {node.manifest.name} "
                         f"at chain height {chain_h}"
+                        + (" (statesync)" if node.manifest.statesync else "")
                     )
                     self._spawn(node)
                     started_late.add(node.manifest.name)
@@ -481,6 +510,40 @@ class Runner:
             f"wait: nodes never reached {target}: "
             f"{ {n: h for n, h in heights.items()} }"
         )
+
+    def _arm_statesync(self, node: _Node, providers: List[_Node]) -> None:
+        """Resolve the light-client trust anchor from a running node and
+        write it into the joiner's [statesync] config — what the
+        reference runner does against the first node's RPC before
+        starting a state-syncing member."""
+        anchor = None
+        trust_height = 0
+        for p in providers:
+            try:
+                status = p.rpc("status")["sync_info"]
+                # Recent anchor: pruning (app retain_height) may have
+                # discarded early blocks, and the snapshot the joiner
+                # restores sits near the tip anyway.
+                trust_height = max(
+                    int(status["earliest_block_height"]),
+                    int(status["latest_block_height"]) - 24,
+                    1,
+                )
+                anchor = p.rpc("block", {"height": trust_height})
+                break
+            except Exception:
+                continue
+        if anchor is None:
+            raise E2EError(
+                f"{node.manifest.name}: no provider served the trust anchor"
+            )
+        cfg = node._cfg  # type: ignore[attr-defined]
+        cfg.statesync.enabled = True
+        cfg.statesync.trust_height = trust_height
+        cfg.statesync.trust_hash = bytes.fromhex(anchor["block_id"]["hash"])
+        cfg.statesync.discovery_time = 2.0
+        cfg.statesync.backfill_blocks = 2
+        cfg.save()
 
     # --- invariants ----------------------------------------------------------
 
