@@ -468,7 +468,9 @@ class Runner:
         if any(n.manifest.statesync for n in self.nodes.values()):
             # snapshot discovery + chunk restore + backfill + catch-up
             # is the longest join path; give it room on loaded machines
-            timeout = max(timeout, 300)
+            # (observed: a joiner under a full parallel test-suite load
+            # syncs correctly but needs several minutes to catch up)
+            timeout = max(timeout, 600)
         running = [
             n for n in self.nodes.values() if n.manifest.start_at == 0
         ]

@@ -83,29 +83,43 @@ class BlockStore:
     def save_block(
         self, block: Block, parts: PartSet, seen_commit: Commit
     ) -> None:
-        """store.go SaveBlock: meta + every part + last_commit + seen commit."""
+        """store.go SaveBlock: meta + every part + last_commit + seen
+        commit, in ONE batch — a process kill between two separate
+        batch writes let the restart handshake advance state past a
+        commit that was never persisted (a torn state
+        reconstructLastCommit cannot repair). One batch closes the
+        process-kill window; FileDB frames batch records individually,
+        so a torn-tail MEDIA crash can still drop the trailing records
+        of a batch (power-loss atomicity would need a batch commit
+        marker in the storage layer)."""
         if block is None:
             raise ValueError("BlockStore can only save a non-nil block")
-        self._save_block_data(block, parts)
-        batch = self._db.new_batch()
-        batch.set(_seen_commit_key(), seen_commit.to_proto_bytes())
-        batch.write()
+        self._save_block_data(
+            block, parts,
+            extra=[(_seen_commit_key(), seen_commit.to_proto_bytes())],
+        )
 
     def save_block_with_extended_commit(
         self, block: Block, parts: PartSet, seen_extended_commit: ExtendedCommit
     ) -> None:
-        """store.go SaveBlockWithExtendedCommit: also persist extensions."""
+        """store.go SaveBlockWithExtendedCommit: also persist extensions
+        (same single-batch atomicity as save_block)."""
         seen_extended_commit.ensure_extensions()
-        self._save_block_data(block, parts)
-        batch = self._db.new_batch()
-        batch.set(_seen_commit_key(), seen_extended_commit.to_commit().to_proto_bytes())
-        batch.set(
-            _ext_commit_key(block.header.height),
-            seen_extended_commit.to_proto_bytes(),
+        self._save_block_data(
+            block, parts,
+            extra=[
+                (
+                    _seen_commit_key(),
+                    seen_extended_commit.to_commit().to_proto_bytes(),
+                ),
+                (
+                    _ext_commit_key(block.header.height),
+                    seen_extended_commit.to_proto_bytes(),
+                ),
+            ],
         )
-        batch.write()
 
-    def _save_block_data(self, block: Block, parts: PartSet) -> None:
+    def _save_block_data(self, block: Block, parts: PartSet, extra=()) -> None:
         height = block.header.height
         with self._mtx:
             expected = self._height + 1 if self._height > 0 else height
@@ -127,6 +141,8 @@ class BlockStore:
                 batch.set(
                     _commit_key(height - 1), block.last_commit.to_proto_bytes()
                 )
+            for k, v in extra:
+                batch.set(k, v)
             batch.write()
             if self._base == 0:
                 self._base = height

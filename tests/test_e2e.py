@@ -107,10 +107,12 @@ perturb = ["disconnect"]
 
 class TestExternalAppTransports:
     def test_testnet_with_grpc_and_socket_apps(self, tmp_path):
-        """A 3-validator testnet where one node's app is out-of-process
+        """A 4-validator testnet where one node's app is out-of-process
         behind the gRPC transport and another behind the socket
         transport — the runner spawns and supervises the app processes
-        and consensus proceeds across all three."""
+        and consensus proceeds across all of them (4 validators keep
+        >2/3 power through any single slow node, the suite's load
+        profile)."""
         manifest = Manifest.parse(
             """
 [testnet]
@@ -125,6 +127,8 @@ proxy_app = "grpc"
 
 [node.validator2]
 proxy_app = "tcp"
+
+[node.validator3]
 """
         )
         events = []
@@ -156,6 +160,8 @@ perturb = ["kill"]
 
 [node.validator2]
 privval = "grpc"
+
+[node.validator3]
 """
         )
         events = []

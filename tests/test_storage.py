@@ -193,3 +193,53 @@ class TestGenesisDoc:
         )
         with pytest.raises(ValueError, match="voting power"):
             gen.validate_and_complete()
+
+
+def test_save_block_is_one_atomic_batch():
+    """Crash-consistency: block data and the seen commit must land in
+    ONE batch write — a SIGKILL between two batches once produced a
+    store whose restart handshake advanced state past a commit that was
+    never persisted (seen commit missing for height N)."""
+    from tests.helpers import CHAIN_ID, make_block_id, make_commit, make_validators
+    from tendermint_tpu.storage import MemDB
+    from tendermint_tpu.storage.blockstore import BlockStore
+    from tendermint_tpu.types import Block, Data, Header
+    from tendermint_tpu.types.part_set import PartSet
+    from tendermint_tpu.types.block import BLOCK_PART_SIZE_BYTES
+    from tendermint_tpu.encoding.canonical import Timestamp
+
+    db = MemDB()
+    writes = []
+    orig_new_batch = db.new_batch
+
+    def counting_new_batch():
+        b = orig_new_batch()
+        orig_write = b.write
+
+        def write():
+            writes.append(1)
+            return orig_write()
+
+        b.write = write
+        return b
+
+    db.new_batch = counting_new_batch
+    bs = BlockStore(db)
+
+    privs, vset = make_validators(2)
+    header = Header(
+        chain_id=CHAIN_ID, height=1,
+        time=Timestamp.from_unix_ns(1_700_000_000_000_000_000),
+        validators_hash=vset.hash(), next_validators_hash=vset.hash(),
+        proposer_address=vset.validators[0].address,
+    )
+    block = Block(header=header, data=Data(txs=[]), last_commit=None)
+    parts = PartSet.from_data(block.to_proto_bytes(), BLOCK_PART_SIZE_BYTES)
+    bid = make_block_id(b"atomic")
+    commit = make_commit(bid, 1, 0, vset, privs)
+
+    writes.clear()
+    bs.save_block(block, parts, commit)
+    assert len(writes) == 1, f"save_block used {len(writes)} batch writes"
+    assert bs.load_seen_commit() is not None
+    assert bs.load_block_meta(1) is not None
