@@ -16,6 +16,7 @@ proto payloads for types).
 
 from __future__ import annotations
 
+import hashlib
 import queue
 import struct
 import threading
@@ -250,22 +251,36 @@ class VotePreverifier:
             return
         try:
             sched = get_shared_scheduler()
-            handle = sched.submit(
-                pub_key.bytes(), vote.sign_bytes(chain_id), vote.signature
-            )
+            sb = vote.sign_bytes(chain_id)
+            # Digest of the EXACT bytes handed to the scheduler: the
+            # _pre_verified tag is only honored when verify() recomputes
+            # the same digest, so a vote mutated between pre-verify and
+            # add_vote can never ride the fast path (types/block.py).
+            sb_digest = hashlib.sha256(sb).digest()
+            handle = sched.submit(pub_key.bytes(), sb, vote.signature)
             ext_handle = None
+            ext_digest = None
             if (
                 vote.type == SIGNED_MSG_TYPE_PRECOMMIT
                 and not vote.block_id.is_nil()
                 and vote.extension_signature
             ):
+                esb = vote.extension_sign_bytes(chain_id)
+                ext_digest = hashlib.sha256(esb).digest()
                 ext_handle = sched.submit(
-                    pub_key.bytes(),
-                    vote.extension_sign_bytes(chain_id),
-                    vote.extension_signature,
+                    pub_key.bytes(), esb, vote.extension_signature
                 )
             self._q.put_nowait(
-                (vote, peer_id, pub_key, handle, ext_handle, time.monotonic())
+                (
+                    vote,
+                    peer_id,
+                    pub_key,
+                    handle,
+                    ext_handle,
+                    time.monotonic(),
+                    sb_digest,
+                    ext_digest,
+                )
             )
         except (RuntimeError, queue.Full):
             # scheduler stopped or backpressure: inline path takes over
@@ -277,9 +292,16 @@ class VotePreverifier:
 
         while not self._stop_flag.is_set():
             try:
-                vote, peer_id, pub_key, handle, ext_handle, t_enq = self._q.get(
-                    timeout=0.1
-                )
+                (
+                    vote,
+                    peer_id,
+                    pub_key,
+                    handle,
+                    ext_handle,
+                    t_enq,
+                    sb_digest,
+                    ext_digest,
+                ) = self._q.get(timeout=0.1)
             except queue.Empty:
                 continue
             sched = get_shared_scheduler()
@@ -301,6 +323,8 @@ class VotePreverifier:
                     self.cs.state.chain_id,
                     pub_key.bytes(),
                     extension_too=bool(ext_ok),
+                    sign_bytes_digest=sb_digest,
+                    extension_digest=ext_digest,
                 )
             else:
                 self.passthrough += 1
@@ -310,6 +334,20 @@ class VotePreverifier:
                     self._deadline_misses += 1
                     if self._deadline_misses >= self.MISS_LIMIT:
                         self._warm.clear()
+                        # Tell the shared health machine the device path
+                        # wedged (a stall is a failure that never raises)
+                        # so other callers also stop feeding it.
+                        from tendermint_tpu.ops.device_policy import (
+                            DeviceStallError,
+                            shared as device_health,
+                        )
+
+                        device_health.record_failure(
+                            DeviceStallError(
+                                "vote pre-verify flush missed its deadline "
+                                f"{self.MISS_LIMIT}x in a row"
+                            )
+                        )
                         threading.Thread(
                             target=self._warmup,
                             name="vote-preverify-rewarm",

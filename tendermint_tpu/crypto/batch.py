@@ -187,6 +187,20 @@ def get_shared_scheduler():
 
                 return verify_batch(pks, msgs, sigs)
 
-            _shared_scheduler = VerifyScheduler(_verify)
+            def _host_fallback(pks, msgs, sigs):
+                # verify_batch already degrades per-chunk via the device
+                # health machine; this catches failures outside it (e.g.
+                # engine import errors) so a flush never fails closed
+                # when the host oracle can still answer it.
+                from tendermint_tpu.crypto.ed25519_ref import verify_zip215
+
+                return [
+                    verify_zip215(p, m, s)
+                    for p, m, s in zip(pks, msgs, sigs)
+                ]
+
+            _shared_scheduler = VerifyScheduler(
+                _verify, fallback_fn=_host_fallback
+            )
             _shared_scheduler.start()
         return _shared_scheduler

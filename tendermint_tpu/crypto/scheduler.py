@@ -49,6 +49,11 @@ class VerifyScheduler:
 
     ``verify_fn(pks, msgs, sigs) -> List[bool]`` is the flush target —
     ``ops.verify_batch`` on a device backend, or any host batch verifier.
+
+    ``fallback_fn`` (optional, same signature) is tried when
+    ``verify_fn`` raises — the seam that keeps the scheduler draining
+    under device degradation instead of failing whole flushes closed.
+    Without a fallback, a raising flush still fails closed.
     """
 
     def __init__(
@@ -58,8 +63,14 @@ class VerifyScheduler:
         ],
         max_batch: int = DEFAULT_MAX_BATCH,
         max_delay: float = DEFAULT_MAX_DELAY,
+        fallback_fn: Optional[
+            Callable[
+                [Sequence[bytes], Sequence[bytes], Sequence[bytes]], List[bool]
+            ]
+        ] = None,
     ):
         self._verify_fn = verify_fn
+        self._fallback_fn = fallback_fn
         self.max_batch = max_batch
         self.max_delay = max_delay
         self._pending: List[_Pending] = []
@@ -70,6 +81,8 @@ class VerifyScheduler:
         # observability
         self.flushes = 0
         self.entries_verified = 0
+        self.flush_errors = 0  # primary verify_fn raised
+        self.fallback_flushes = 0  # fallback_fn answered a failed flush
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -149,14 +162,24 @@ class VerifyScheduler:
                 )
             if not batch:
                 continue
+            pks = [p.pubkey for p in batch]
+            msgs = [p.msg for p in batch]
+            sigs = [p.sig for p in batch]
             try:
-                oks = self._verify_fn(
-                    [p.pubkey for p in batch],
-                    [p.msg for p in batch],
-                    [p.sig for p in batch],
-                )
+                oks = self._verify_fn(pks, msgs, sigs)
             except Exception:
-                oks = [False] * len(batch)  # fail closed, never hang callers
+                self.flush_errors += 1
+                oks = None
+                if self._fallback_fn is not None:
+                    try:
+                        oks = self._fallback_fn(pks, msgs, sigs)
+                        self.fallback_flushes += 1
+                    except Exception:
+                        oks = None
+                if oks is None:
+                    oks = [False] * len(batch)  # fail closed, never hang callers
+            if len(oks) != len(batch):  # misbehaving verifier: fail closed
+                oks = [False] * len(batch)
             self.flushes += 1
             self.entries_verified += len(batch)
             for p, ok in zip(batch, oks):

@@ -432,7 +432,14 @@ class _ConnState:
 
 def _strip_padding(flags: int, payload: bytes) -> bytes:
     if flags & FLAG_PADDED:
+        # RFC 7540 §6.1/§6.2: the Pad Length field must exist and the
+        # padding must fit inside the remaining payload. A malformed
+        # frame is a connection error, not an IndexError.
+        if not payload:
+            raise H2ProtocolError("PADDED frame with empty payload")
         pad = payload[0]
+        if pad >= len(payload):
+            raise H2ProtocolError("padding exceeds frame payload")
         payload = payload[1 : len(payload) - pad]
     return payload
 
@@ -542,6 +549,7 @@ class GrpcChannel:
             data = bytearray()
             headers: List[Tuple[str, str]] = []
             header_block = bytearray()
+            block_end_stream = False
             while True:
                 ftype, flags, sid, frame = conn.next_stream_frame()
                 if sid != stream_id:
@@ -553,14 +561,20 @@ class GrpcChannel:
                         frame = _strip_padding(flags, frame)
                         if flags & FLAG_PRIORITY:
                             frame = frame[5:]
+                        # END_STREAM rides the HEADERS frame, but the
+                        # header block isn't complete (or decodable)
+                        # until END_HEADERS — honoring it early would
+                        # drop trailers split across CONTINUATION
+                        # frames (losing grpc-status).
+                        block_end_stream = bool(flags & FLAG_END_STREAM)
                     header_block += frame
                     if len(header_block) > MAX_HEADER_BLOCK:
                         raise H2ProtocolError("header block too large")
                     if flags & FLAG_END_HEADERS:
                         headers += conn.decoder.decode(bytes(header_block))
                         header_block.clear()
-                    if flags & FLAG_END_STREAM:
-                        break
+                        if block_end_stream:
+                            break
                     continue
                 if ftype == FRAME_DATA:
                     frame = _strip_padding(flags, frame)
@@ -669,6 +683,13 @@ class GrpcServer:
                 ftype, flags, sid, frame = conn.next_stream_frame()
                 if ftype in (FRAME_HEADERS, FRAME_CONTINUATION):
                     if ftype == FRAME_HEADERS:
+                        if block_stream != 0:
+                            # RFC 7540 §4.3: a header block must not be
+                            # interleaved with frames of any other kind
+                            # or stream.
+                            raise H2ProtocolError(
+                                "HEADERS while a header block is open"
+                            )
                         frame = _strip_padding(flags, frame)
                         if flags & FLAG_PRIORITY:
                             frame = frame[5:]
@@ -677,14 +698,27 @@ class GrpcServer:
                             raise H2ProtocolError("too many in-flight streams")
                         streams[sid] = [None, bytearray(), False]
                         conn.open_stream(sid)
+                    else:  # CONTINUATION
+                        if block_stream == 0:
+                            raise H2ProtocolError(
+                                "CONTINUATION without a preceding HEADERS"
+                            )
+                        if sid != block_stream:
+                            raise H2ProtocolError(
+                                "CONTINUATION on the wrong stream"
+                            )
                     header_block += frame
                     if len(header_block) > MAX_HEADER_BLOCK:
                         raise H2ProtocolError("header block too large")
                     if flags & FLAG_END_HEADERS:
-                        streams[block_stream][0] = conn.decoder.decode(
-                            bytes(header_block)
-                        )
+                        # Decode even if the stream was reset meanwhile:
+                        # skipping would desync the HPACK dynamic table
+                        # for every later stream on this connection.
+                        decoded = conn.decoder.decode(bytes(header_block))
+                        if block_stream in streams:
+                            streams[block_stream][0] = decoded
                         header_block.clear()
+                        block_stream = 0
                     if flags & FLAG_END_STREAM and sid in streams:
                         streams[sid][2] = True
                 elif ftype == FRAME_DATA and sid in streams:

@@ -352,6 +352,44 @@ class MempoolMetrics(_NopMixin):
 
 
 
+class OpsMetrics(_NopMixin):
+    """Accelerator verification path: device health state machine
+    (ops/device_policy.py), per-engine CPU fallbacks, probe latency.
+    No metrics.gen.go analog — the reference has no device boundary."""
+
+    def __init__(self, reg: Optional[Registry]):
+        reg = reg or Registry()
+        s = "ops"
+        self.device_health_state = reg.gauge(
+            _name(s, "device_health_state"),
+            "Device health state: 0=healthy 1=degraded 2=cooldown 3=disabled.",
+        )
+        self.device_transitions = reg.counter(
+            _name(s, "device_health_transitions_total"),
+            "Device health state transitions.",
+            labels=("from_state", "to_state"),
+        )
+        self.device_failures = reg.counter(
+            _name(s, "device_failures_total"),
+            "Device-path failures by classification.",
+            labels=("kind",),
+        )
+        self.device_fallbacks = reg.counter(
+            _name(s, "device_fallbacks_total"),
+            "Batches (or chunks) served by the CPU fallback path.",
+            labels=("engine",),
+        )
+        self.device_fallback_lanes = reg.counter(
+            _name(s, "device_fallback_lanes_total"),
+            "Signature lanes served by the CPU fallback path.",
+            labels=("engine",),
+        )
+        self.device_probe_seconds = reg.histogram(
+            _name(s, "device_probe_seconds"),
+            "Latency of half-open re-probe attempts, seconds.",
+        )
+
+
 class StateMetrics(_NopMixin):
     """internal/state/metrics.gen.go."""
 

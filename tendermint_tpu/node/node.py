@@ -274,6 +274,7 @@ class Node:
         from tendermint_tpu.libs.metrics import (
             ConsensusMetrics,
             MempoolMetrics,
+            OpsMetrics,
             P2PMetrics,
             Registry,
             StateMetrics,
@@ -287,6 +288,12 @@ class Node:
         mempool_metrics = MempoolMetrics(self.metrics_registry)
         p2p_metrics = P2PMetrics(self.metrics_registry)
         state_metrics = StateMetrics(self.metrics_registry)
+        ops_metrics = OpsMetrics(self.metrics_registry)
+        # Mirror the process-wide device health machine into this node's
+        # registry so /metrics exposes degradation and recovery.
+        from tendermint_tpu.ops.device_policy import shared as _device_health
+
+        _device_health.bind_metrics(ops_metrics)
 
         # --- pools + executor (node.go:258-297) ------------------------------
         self.mempool = TxMempool(

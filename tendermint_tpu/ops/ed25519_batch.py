@@ -254,16 +254,14 @@ def _compiled_kernel(n: int, backend: Optional[str], mul_impl: str = "vpu"):
 
     The field-mul impl ("vpu" f32 shifts vs "mxu" int8 dot_general —
     see ops/field_mxu.py) is a trace-time switch on field32, so it is
-    pinned here around the trace and must be part of the cache key.
+    pinned here around the trace — under field32's trace lock, so
+    concurrent first compilations can't interleave their set/restore —
+    and must be part of the cache key.
     """
 
     def run(pk, r, s, k):
-        prev = field.get_mul_impl()
-        field.set_mul_impl(mul_impl)
-        try:
+        with field.pinned_mul_impl(mul_impl):
             return verify_kernel(pk, r, s, k)
-        finally:
-            field.set_mul_impl(prev)
 
     return jax.jit(run, backend=backend)
 
@@ -310,6 +308,9 @@ def active_impl(backend: Optional[str] = None) -> str:
 def _run_chunk(inputs: dict, lo: int, hi: int, backend: Optional[str]):
     """Dispatch one padded chunk, preferring Pallas on TPU backends."""
     global _PALLAS_BROKEN
+    from tendermint_tpu.ops import fault_injection
+
+    fault_injection.fire("ed25519.chunk")
     args = (
         jnp.asarray(inputs["pk"][lo:hi]),
         jnp.asarray(inputs["r"][lo:hi]),
@@ -449,6 +450,25 @@ def prepare_batch(
     return inputs, host_ok
 
 
+def _host_verify_lanes(
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    lo: int,
+    hi: int,
+) -> np.ndarray:
+    """CPU oracle over lanes [lo, hi) of the original (unpadded) batch."""
+    from tendermint_tpu.crypto.ed25519_ref import verify_zip215
+
+    return np.array(
+        [
+            verify_zip215(pubkeys[i], msgs[i], sigs[i])
+            for i in range(lo, hi)
+        ],
+        dtype=bool,
+    )
+
+
 def verify_batch(
     pubkeys: Sequence[bytes],
     msgs: Sequence[bytes],
@@ -463,40 +483,101 @@ def verify_batch(
     Batches larger than CHUNK are split and their kernel calls enqueued
     back-to-back so H2D transfer of chunk j+1 overlaps compute of
     chunk j (JAX async dispatch).
+
+    Device failures degrade per CHUNK, not per process: a chunk whose
+    dispatch or materialization fails is re-verified on the CPU oracle
+    while the rest of the batch stays on the device (if the health
+    state machine — ops/device_policy.py — still admits it). A batch
+    that completes on the device re-promotes a degraded path; the
+    state machine alone decides when the device is cooling down or
+    disabled, and it recovers via half-open probe batches.
     """
-    from tendermint_tpu.ops.device_policy import shared as device_policy
+    from tendermint_tpu.ops import fault_injection
+    from tendermint_tpu.ops.device_policy import shared as health
 
     n = len(pubkeys)
     if n == 0:
         return []
-    if not device_policy.broken:
+    attempt = health.begin_attempt("ed25519")
+    if attempt is None:
+        # DISABLED, or cooling down (another caller may hold the probe
+        # slot). Instant answer — the circuit breaker never blocks.
+        health.count_fallback("ed25519", n)
+        return list(_host_verify_lanes(pubkeys, msgs, sigs, 0, n))
+
+    try:
+        inputs, host_ok = prepare_batch(pubkeys, msgs, sigs, pad_to=_bucket(n))
+    except Exception as exc:
+        # Host prep failed before any device work. Never take the node
+        # down over infrastructure — degrade to the host oracle.
+        health.record_failure(exc, attempt)
+        import warnings
+
+        warnings.warn(
+            f"batch prepare failed ({exc!r}); host fallback "
+            f"(device state={health.state})"
+        )
+        health.count_fallback("ed25519", n)
+        return list(_host_verify_lanes(pubkeys, msgs, sigs, 0, n))
+
+    m = inputs["pk"].shape[0]
+    # Dispatch phase: enqueue chunk kernels back-to-back; a chunk whose
+    # dispatch raises falls back to the host WITHOUT abandoning the
+    # remaining chunks (the health machine re-admits or refuses them).
+    chunks = []  # (lo, hi, device result or None)
+    for lo in range(0, m, CHUNK):
+        hi = min(lo + CHUNK, m)
+        if attempt is None:
+            attempt = health.begin_attempt("ed25519")
+        if attempt is None:
+            chunks.append((lo, hi, None))
+            continue
         try:
-            inputs, host_ok = prepare_batch(
-                pubkeys, msgs, sigs, pad_to=_bucket(n)
-            )
-            m = inputs["pk"].shape[0]
-            outs = []
-            for lo in range(0, m, CHUNK):
-                hi = min(lo + CHUNK, m)
-                outs.append(_run_chunk(inputs, lo, hi, backend))
-            device_ok = np.concatenate([np.asarray(o) for o in outs])[:n]
-            device_policy.record_success()
-            return list(np.logical_and(device_ok, host_ok))
+            chunks.append((lo, hi, _run_chunk(inputs, lo, hi, backend)))
         except Exception as exc:
-            # Verification must never take the node down over
-            # infrastructure — degrade to the host oracle. The shared
-            # policy (ops/device_policy.py) decides when the fallback
-            # goes sticky for the whole process and BOTH engines.
-            sticky = device_policy.record_failure(exc)
+            health.record_failure(exc, attempt)
+            attempt = None
             import warnings
 
             warnings.warn(
-                f"device batch verify failed ({exc!r}); host fallback "
-                f"(sticky={sticky})"
+                f"device chunk [{lo}:{hi}] dispatch failed ({exc!r}); "
+                f"CPU fallback for the chunk (device state={health.state})"
             )
-    from tendermint_tpu.crypto.ed25519_ref import verify_zip215
+            chunks.append((lo, hi, None))
 
-    return [
-        verify_zip215(pk, m_, s)
-        for pk, m_, s in zip(pubkeys, msgs, sigs)
-    ]
+    # Collect phase: JAX dispatch is async, so runtime errors can
+    # surface at materialization; those too degrade per chunk.
+    results = np.ones(m, dtype=bool)
+    fallback_lanes = 0
+    device_chunks_ok = 0
+    for lo, hi, out in chunks:
+        ok = None
+        if out is not None:
+            try:
+                fault_injection.fire("ed25519.collect")
+                ok = np.asarray(out)
+                device_chunks_ok += 1
+            except Exception as exc:
+                health.record_failure(exc, attempt)
+                attempt = None
+                import warnings
+
+                warnings.warn(
+                    f"device chunk [{lo}:{hi}] failed at collect ({exc!r}); "
+                    f"CPU fallback for the chunk (device state={health.state})"
+                )
+        if ok is None:
+            ok = np.ones(hi - lo, dtype=bool)
+            top = min(hi, n)  # padded lanes need no host verify
+            if lo < top:
+                fallback_lanes += top - lo
+                ok[: top - lo] = _host_verify_lanes(pubkeys, msgs, sigs, lo, top)
+        results[lo:hi] = ok
+
+    if fallback_lanes:
+        health.count_fallback("ed25519", fallback_lanes)
+    if attempt is not None and device_chunks_ok:
+        # No failure consumed the attempt and device work round-tripped:
+        # re-promote (clears DEGRADED, completes a half-open probe).
+        health.record_success(attempt)
+    return [bool(v) for v in np.logical_and(results[:n], host_ok)]

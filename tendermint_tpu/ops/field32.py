@@ -137,10 +137,18 @@ def fe_neg(a: jnp.ndarray) -> jnp.ndarray:
 # Which multiply formulation fe_mul traces: "vpu" = the f32 shifted
 # multiply-adds below; "mxu" = the int8 dot_general contraction in
 # :mod:`field_mxu`. Read at TRACE time — compiled-kernel caches must key
-# on it (ops/ed25519_batch._compiled_kernel does).
+# on it (ops/ed25519_batch._compiled_kernel does), and any set/trace/
+# restore sequence must hold :data:`_TRACE_MTX` (use
+# :func:`pinned_mul_impl`) so concurrent first compilations from
+# different threads (ed25519 scheduler thread vs an sr25519 caller)
+# can't interleave and bake the wrong implementation into an lru-cached
+# kernel.
+import contextlib as _contextlib
 import os as _os
+import threading as _threading
 
 _MUL_IMPL = _os.environ.get("TENDERMINT_TPU_FIELD_MUL", "vpu")
+_TRACE_MTX = _threading.RLock()
 
 
 def set_mul_impl(impl: str) -> None:
@@ -152,6 +160,19 @@ def set_mul_impl(impl: str) -> None:
 
 def get_mul_impl() -> str:
     return _MUL_IMPL
+
+
+@_contextlib.contextmanager
+def pinned_mul_impl(impl: str):
+    """Pin the multiply implementation for the duration of a trace,
+    serialized against every other pinned trace in the process."""
+    with _TRACE_MTX:
+        prev = get_mul_impl()
+        set_mul_impl(impl)
+        try:
+            yield
+        finally:
+            set_mul_impl(prev)
 
 
 def fe_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

@@ -146,12 +146,10 @@ def verify_kernel_sr(
 @lru_cache(maxsize=16)
 def _compiled_kernel_sr(n: int, backend: Optional[str], mul_impl: str = "vpu"):
     def run(pk, r, s, k):
-        prev = field.get_mul_impl()
-        field.set_mul_impl(mul_impl)
-        try:
+        # Under field32's trace lock: a concurrent ed25519 first-compile
+        # must not interleave its set/restore with ours.
+        with field.pinned_mul_impl(mul_impl):
             return verify_kernel_sr(pk, r, s, k)
-        finally:
-            field.set_mul_impl(prev)
 
     return jax.jit(run, backend=backend)
 
@@ -168,19 +166,24 @@ def verify_batch_sr(
     """Per-entry schnorrkel batch verification on the device, host
     Merlin challenges. Large batches dispatch in CHUNK-size launches
     (one compiled kernel, H2D of chunk j+1 overlapping compute of
-    chunk j); device failure degrades to the host oracle under the
-    process-wide policy shared with ed25519 (ops/device_policy.py)."""
+    chunk j); device failure degrades per CHUNK to the host oracle
+    under the process-wide health state machine shared with ed25519
+    (ops/device_policy.py), which cools down, probes, and re-promotes
+    the device path by itself."""
     from tendermint_tpu.crypto.sr25519 import (
         _challenge,
         _signing_transcript,
         verify as verify_host,
     )
-    from tendermint_tpu.ops.device_policy import shared as device_policy
+    from tendermint_tpu.ops import fault_injection
+    from tendermint_tpu.ops.device_policy import shared as health
 
     n = len(pubkeys)
     if n == 0:
         return []
-    if device_policy.broken:
+    attempt = health.begin_attempt("sr25519")
+    if attempt is None:
+        health.count_fallback("sr25519", n)
         return [verify_host(p, m, s) for p, m, s in zip(pubkeys, msgs, sigs)]
 
     host_ok = np.ones(n, dtype=bool)
@@ -219,27 +222,91 @@ def verify_batch_sr(
 
         impl = active_impl(backend)
         mul_impl = "mxu" if impl == "mxu" else field.get_mul_impl()
-        outs = []
-        for lo in range(0, m, CHUNK):
-            hi = min(lo + CHUNK, m)
-            outs.append(
-                _compiled_kernel_sr(hi - lo, backend, mul_impl)(
-                    jnp.asarray(pk_arr[lo:hi]), jnp.asarray(r_arr[lo:hi]),
-                    jnp.asarray(s_arr[lo:hi]), jnp.asarray(k_arr[lo:hi]),
-                )
-            )
-        device_ok = np.concatenate([np.asarray(o) for o in outs])[:n]
-        device_policy.record_success()
-        return list(np.logical_and(device_ok, host_ok))
     except Exception as exc:
-        sticky = device_policy.record_failure(exc)
+        # Host-side prep failure before any device work.
+        health.record_failure(exc, attempt)
         import warnings
 
         warnings.warn(
-            f"sr25519 device batch failed ({exc!r}); host fallback "
-            f"(sticky={sticky})"
+            f"sr25519 batch prepare failed ({exc!r}); host fallback "
+            f"(device state={health.state})"
         )
+        health.count_fallback("sr25519", n)
         return [verify_host(p, m, s) for p, m, s in zip(pubkeys, msgs, sigs)]
+
+    # Dispatch phase: enqueue chunk kernels back-to-back (H2D of chunk
+    # j+1 overlaps compute of chunk j). A failing chunk falls back to
+    # the host oracle for ITS lanes only; the health machine decides
+    # whether the remaining chunks may still use the device.
+    chunks = []  # (lo, hi, device result or None)
+    for lo in range(0, m, CHUNK):
+        hi = min(lo + CHUNK, m)
+        if attempt is None:
+            attempt = health.begin_attempt("sr25519")
+        if attempt is None:
+            chunks.append((lo, hi, None))
+            continue
+        try:
+            fault_injection.fire("sr25519.chunk")
+            chunks.append(
+                (
+                    lo,
+                    hi,
+                    _compiled_kernel_sr(hi - lo, backend, mul_impl)(
+                        jnp.asarray(pk_arr[lo:hi]), jnp.asarray(r_arr[lo:hi]),
+                        jnp.asarray(s_arr[lo:hi]), jnp.asarray(k_arr[lo:hi]),
+                    ),
+                )
+            )
+        except Exception as exc:
+            health.record_failure(exc, attempt)
+            attempt = None
+            import warnings
+
+            warnings.warn(
+                f"sr25519 device chunk [{lo}:{hi}] dispatch failed ({exc!r}); "
+                f"CPU fallback for the chunk (device state={health.state})"
+            )
+            chunks.append((lo, hi, None))
+
+    # Collect phase: async dispatch surfaces runtime errors here too.
+    results = np.ones(m, dtype=bool)
+    fallback_lanes = 0
+    device_chunks_ok = 0
+    for lo, hi, out in chunks:
+        ok = None
+        if out is not None:
+            try:
+                ok = np.asarray(out)
+                device_chunks_ok += 1
+            except Exception as exc:
+                health.record_failure(exc, attempt)
+                attempt = None
+                import warnings
+
+                warnings.warn(
+                    f"sr25519 device chunk [{lo}:{hi}] failed at collect "
+                    f"({exc!r}); CPU fallback (device state={health.state})"
+                )
+        if ok is None:
+            ok = np.ones(hi - lo, dtype=bool)
+            top = min(hi, n)  # padded lanes need no host verify
+            if lo < top:
+                fallback_lanes += top - lo
+                ok[: top - lo] = np.array(
+                    [
+                        verify_host(pubkeys[i], msgs[i], sigs[i])
+                        for i in range(lo, top)
+                    ],
+                    dtype=bool,
+                )
+        results[lo:hi] = ok
+
+    if fallback_lanes:
+        health.count_fallback("sr25519", fallback_lanes)
+    if attempt is not None and device_chunks_ok:
+        health.record_success(attempt)
+    return [bool(v) for v in np.logical_and(results[:n], host_ok)]
 
 
 _PAD: Optional[Tuple[np.ndarray, ...]] = None

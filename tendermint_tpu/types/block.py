@@ -12,6 +12,7 @@ an unset time.Time.
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field as dc_field
 from typing import List, Optional
 
@@ -579,11 +580,32 @@ class Vote:
     )
 
     def mark_pre_verified(
-        self, chain_id: str, pub_key_bytes: bytes, extension_too: bool = False
+        self,
+        chain_id: str,
+        pub_key_bytes: bytes,
+        extension_too: bool = False,
+        sign_bytes_digest: Optional[bytes] = None,
+        extension_digest: Optional[bytes] = None,
     ) -> None:
-        self._pre_verified = (chain_id, pub_key_bytes)
+        """Record that a batch path already verified this vote.
+
+        The tag is self-validating: it carries a digest of the sign-bytes
+        that were actually verified, and :meth:`verify` recomputes the
+        digest before honoring the tag — so mutating any signed field
+        after pre-verification silently demotes the vote to a full
+        signature check instead of skipping it. Callers that verified
+        specific bytes (the preverifier) pass their digest; otherwise it
+        is computed here from the vote's current content.
+        """
+        if sign_bytes_digest is None:
+            sign_bytes_digest = hashlib.sha256(self.sign_bytes(chain_id)).digest()
+        self._pre_verified = (chain_id, pub_key_bytes, sign_bytes_digest)
         if extension_too:
-            self._pre_verified_ext = (chain_id, pub_key_bytes)
+            if extension_digest is None:
+                extension_digest = hashlib.sha256(
+                    self.extension_sign_bytes(chain_id)
+                ).digest()
+            self._pre_verified_ext = (chain_id, pub_key_bytes, extension_digest)
 
     def is_nil_vote(self) -> bool:
         return self.block_id.is_nil()
@@ -630,9 +652,14 @@ class Vote:
         """types/vote.go Verify: address match + signature over sign-bytes."""
         if pub_key.address() != self.validator_address:
             raise VoteError("invalid validator address")
-        if self._pre_verified == (chain_id, pub_key.bytes()):
-            return  # already verified against this exact key via batch
-        if not pub_key.verify_signature(self.sign_bytes(chain_id), self.signature):
+        sb = self.sign_bytes(chain_id)
+        if self._pre_verified == (
+            chain_id,
+            pub_key.bytes(),
+            hashlib.sha256(sb).digest(),
+        ):
+            return  # batch-verified this key over these EXACT sign-bytes
+        if not pub_key.verify_signature(sb, self.signature):
             raise VoteError("invalid signature")
 
     def verify_vote_and_extension(self, chain_id: str, pub_key: PubKey) -> None:
@@ -648,11 +675,14 @@ class Vote:
     def verify_extension(self, chain_id: str, pub_key: PubKey) -> None:
         if self.type != SIGNED_MSG_TYPE_PRECOMMIT or self.block_id.is_nil():
             return
-        if self._pre_verified_ext == (chain_id, pub_key.bytes()):
-            return
-        if not pub_key.verify_signature(
-            self.extension_sign_bytes(chain_id), self.extension_signature
+        esb = self.extension_sign_bytes(chain_id)
+        if self._pre_verified_ext == (
+            chain_id,
+            pub_key.bytes(),
+            hashlib.sha256(esb).digest(),
         ):
+            return
+        if not pub_key.verify_signature(esb, self.extension_signature):
             raise VoteError("invalid extension signature")
 
     def validate_basic(self) -> None:

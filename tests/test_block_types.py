@@ -148,6 +148,89 @@ class TestVote:
         with pytest.raises(VoteError, match="extension"):
             vote.verify_vote_and_extension(CHAIN_ID, priv.pub_key())
 
+    def test_pre_verified_fast_path_is_self_validating(self):
+        """The _pre_verified tag carries a digest of the verified
+        sign-bytes; mutating any signed field after marking must demote
+        the vote to a full (failing) signature check."""
+        priv = Ed25519PrivKey.from_seed(b"\x0b" * 32)
+        vote = Vote(
+            type=SIGNED_MSG_TYPE_PREVOTE,
+            height=10,
+            round=2,
+            block_id=make_block_id(),
+            timestamp=_ts(),
+            validator_address=priv.pub_key().address(),
+            validator_index=0,
+        )
+        vote.signature = priv.sign(vote.sign_bytes(CHAIN_ID))
+        vote.mark_pre_verified(CHAIN_ID, priv.pub_key().bytes())
+        # tag honored while content is untouched (even with a clobbered
+        # signature — that is the point of the fast path)
+        vote.signature = b"\x00" * 64
+        vote.verify(CHAIN_ID, priv.pub_key())
+        # any signed-field mutation invalidates the tag
+        vote.height = 11
+        with pytest.raises(VoteError, match="signature"):
+            vote.verify(CHAIN_ID, priv.pub_key())
+
+    def test_pre_verified_extension_tag_checks_digest(self):
+        priv = Ed25519PrivKey.from_seed(b"\x0c" * 32)
+        vote = Vote(
+            type=SIGNED_MSG_TYPE_PRECOMMIT,
+            height=3,
+            round=0,
+            block_id=make_block_id(),
+            timestamp=_ts(),
+            validator_address=priv.pub_key().address(),
+            extension=b"oracle-price:42",
+        )
+        vote.signature = priv.sign(vote.sign_bytes(CHAIN_ID))
+        vote.extension_signature = priv.sign(
+            vote.extension_sign_bytes(CHAIN_ID)
+        )
+        vote.mark_pre_verified(
+            CHAIN_ID, priv.pub_key().bytes(), extension_too=True
+        )
+        vote.verify_vote_and_extension(CHAIN_ID, priv.pub_key())
+        # tampering with the extension after pre-verification must not
+        # ride the fast path
+        vote.extension = b"oracle-price:9000"
+        with pytest.raises(VoteError, match="extension"):
+            vote.verify_extension(CHAIN_ID, priv.pub_key())
+
+    def test_pre_verified_explicit_digest_must_match(self):
+        priv = Ed25519PrivKey.from_seed(b"\x0d" * 32)
+        vote = Vote(
+            type=SIGNED_MSG_TYPE_PREVOTE,
+            height=10,
+            round=2,
+            block_id=make_block_id(),
+            timestamp=_ts(),
+            validator_address=priv.pub_key().address(),
+            validator_index=0,
+        )
+        vote.signature = b"\x00" * 64  # invalid; only the tag could pass
+        # a stale digest (of DIFFERENT bytes than the vote's current
+        # sign-bytes) must not be honored
+        vote.mark_pre_verified(
+            CHAIN_ID,
+            priv.pub_key().bytes(),
+            sign_bytes_digest=hashlib.sha256(b"not these bytes").digest(),
+        )
+        with pytest.raises(VoteError, match="signature"):
+            vote.verify(CHAIN_ID, priv.pub_key())
+        # the digest of the exact sign-bytes is honored
+        vote.signature = priv.sign(vote.sign_bytes(CHAIN_ID))
+        vote.mark_pre_verified(
+            CHAIN_ID,
+            priv.pub_key().bytes(),
+            sign_bytes_digest=hashlib.sha256(
+                vote.sign_bytes(CHAIN_ID)
+            ).digest(),
+        )
+        vote.signature = b"\x00" * 64
+        vote.verify(CHAIN_ID, priv.pub_key())
+
     def test_commit_sig_conversion(self):
         priv = Ed25519PrivKey.from_seed(b"\x0a" * 32)
         vote = Vote(

@@ -399,3 +399,144 @@ def test_window_update_credits_named_stream_only():
     finally:
         a.close()
         b.close()
+
+
+# --- frame-level protocol regressions ---------------------------------------
+
+
+def test_client_trailers_split_across_continuation():
+    """END_STREAM rides the trailers HEADERS frame, but the header block
+    may finish in a CONTINUATION frame. Honoring END_STREAM before
+    END_HEADERS loses the trailers — including grpc-status."""
+    import socket as socketlib
+
+    from tendermint_tpu.libs.grpc import (
+        FLAG_END_HEADERS,
+        FLAG_END_STREAM,
+        FRAME_CONTINUATION,
+        FRAME_DATA,
+        FRAME_HEADERS,
+        _ConnState,
+        grpc_frame,
+        read_frame,
+        write_frame,
+    )
+
+    a, b = socketlib.socketpair()
+    a.settimeout(5)
+    b.settimeout(5)
+    ch = GrpcChannel("127.0.0.1", 1)
+    ch._conn = _ConnState(a)  # bypass connect: the peer is scripted
+
+    def fake_server():
+        # drain the request until END_STREAM
+        while True:
+            ftype, flags, sid, frame = read_frame(b)
+            if flags & FLAG_END_STREAM:
+                break
+        hdrs = hpack_encode(
+            [(":status", "200"), ("content-type", "application/grpc")]
+        )
+        write_frame(b, FRAME_HEADERS, FLAG_END_HEADERS, sid, hdrs)
+        write_frame(b, FRAME_DATA, 0, sid, grpc_frame(b"ignored"))
+        trailers = hpack_encode(
+            [("grpc-status", "7"), ("grpc-message", "denied")]
+        )
+        # END_STREAM on HEADERS, END_HEADERS only on the CONTINUATION
+        write_frame(b, FRAME_HEADERS, FLAG_END_STREAM, sid, trailers[:3])
+        write_frame(
+            b, FRAME_CONTINUATION, FLAG_END_HEADERS, sid, trailers[3:]
+        )
+
+    t = threading.Thread(target=fake_server, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(GrpcError) as ei:
+            ch.unary("/svc/method", b"req")
+        assert ei.value.status == 7
+        assert "denied" in ei.value.message
+    finally:
+        t.join(timeout=5)
+        a.close()
+        b.close()
+
+
+def _drive_server_conn(payload_frames):
+    """Feed raw bytes (after the client preface) into a GrpcServer
+    connection handler and return normally iff the server treated the
+    input as a handled protocol error (not an unhandled crash)."""
+    import socket as socketlib
+
+    from tendermint_tpu.libs.grpc import PREFACE
+
+    srv = GrpcServer({}, port=0)
+    a, b = socketlib.socketpair()
+    a.settimeout(5)
+    b.settimeout(5)
+    try:
+        b.sendall(PREFACE + payload_frames)
+        b.shutdown(socketlib.SHUT_WR)
+        # runs in THIS thread: an uncaught KeyError/IndexError escapes
+        # and fails the test
+        srv._serve_conn(a)
+    finally:
+        a.close()
+        b.close()
+        srv.stop()
+
+
+def _frame_bytes(ftype, flags, sid, payload):
+    import struct
+
+    return struct.pack("!I", len(payload))[1:] + bytes(
+        [ftype, flags]
+    ) + struct.pack("!I", sid) + payload
+
+
+def test_server_continuation_without_headers_is_protocol_error():
+    from tendermint_tpu.libs.grpc import FLAG_END_HEADERS, FRAME_CONTINUATION
+
+    _drive_server_conn(
+        _frame_bytes(
+            FRAME_CONTINUATION, FLAG_END_HEADERS, 1, hpack_encode([("a", "b")])
+        )
+    )
+
+
+def test_server_continuation_on_wrong_stream_is_protocol_error():
+    from tendermint_tpu.libs.grpc import (
+        FLAG_END_HEADERS,
+        FRAME_CONTINUATION,
+        FRAME_HEADERS,
+    )
+
+    block = hpack_encode([(":path", "/x")])
+    _drive_server_conn(
+        _frame_bytes(FRAME_HEADERS, 0, 1, block)
+        + _frame_bytes(FRAME_CONTINUATION, FLAG_END_HEADERS, 3, b"")
+    )
+
+
+def test_server_empty_padded_headers_is_protocol_error():
+    from tendermint_tpu.libs.grpc import FLAG_END_HEADERS, FLAG_PADDED, FRAME_HEADERS
+
+    _drive_server_conn(
+        _frame_bytes(FRAME_HEADERS, FLAG_END_HEADERS | FLAG_PADDED, 1, b"")
+    )
+
+
+def test_strip_padding_rejects_malformed():
+    from tendermint_tpu.libs.grpc import (
+        FLAG_PADDED,
+        H2ProtocolError,
+        _strip_padding,
+    )
+
+    assert _strip_padding(0, b"") == b""
+    assert _strip_padding(FLAG_PADDED, b"\x02abXX") == b"ab"
+    with pytest.raises(H2ProtocolError):
+        _strip_padding(FLAG_PADDED, b"")
+    with pytest.raises(H2ProtocolError):
+        _strip_padding(FLAG_PADDED, b"\x05abc")  # pad > remaining payload
+    # all-padding is legal and yields empty content
+    assert _strip_padding(FLAG_PADDED, b"\x03\x00\x00\x00") == b""

@@ -171,7 +171,11 @@ def test_valid_vote_marked_and_forwarded(net):
     assert cs.wait_received(1)
     got, peer = cs.received[0]
     assert peer == "peer-a"
-    assert got._pre_verified == (CHAIN_ID, vset.validators[1].pub_key.bytes())
+    assert got._pre_verified is not None
+    assert got._pre_verified[:2] == (
+        CHAIN_ID,
+        vset.validators[1].pub_key.bytes(),
+    )
     assert pv.batched == 1
     # the mark lets VoteSet's verify path skip the host verify
     got.verify(CHAIN_ID, vset.validators[1].pub_key)
@@ -230,7 +234,8 @@ def test_extension_pre_verified_for_precommit(net):
     pv.submit(vote, "peer-x")
     assert cs.wait_received(1)
     got, _ = cs.received[0]
-    assert got._pre_verified_ext == (CHAIN_ID, val.pub_key.bytes())
+    assert got._pre_verified_ext is not None
+    assert got._pre_verified_ext[:2] == (CHAIN_ID, val.pub_key.bytes())
     got.verify_extension(CHAIN_ID, val.pub_key)
 
 
