@@ -25,6 +25,23 @@ from tendermint_tpu.crypto.keys import (
 DEVICE_THRESHOLD = 16
 
 
+def note_validator_set(vals) -> None:
+    """Register the active validator set with the device precompute
+    cache (ops/precompute.py): its ed25519 keys become eligible for
+    per-validator table caching, and stale keys from rotated-out sets
+    are dropped. Never raises — cache warm-up must not be able to fail
+    a verification — and stays a no-op when the ops engine is absent.
+    """
+    try:
+        from tendermint_tpu.ops import precompute
+    except ImportError:
+        return
+    try:
+        precompute.activate_validator_set(vals)
+    except Exception:
+        pass
+
+
 class BatchVerifier:
     """crypto.BatchVerifier contract (crypto/crypto.go:58-76): Add entries,
     then Verify once; returns (all_valid, per-entry validity)."""

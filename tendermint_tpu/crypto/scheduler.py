@@ -81,6 +81,7 @@ class VerifyScheduler:
         # observability
         self.flushes = 0
         self.entries_verified = 0
+        self.entries_coalesced = 0  # duplicate submissions answered by one lane
         self.flush_errors = 0  # primary verify_fn raised
         self.fallback_flushes = 0  # fallback_fn answered a failed flush
 
@@ -162,9 +163,25 @@ class VerifyScheduler:
                 )
             if not batch:
                 continue
-            pks = [p.pubkey for p in batch]
-            msgs = [p.msg for p in batch]
-            sigs = [p.sig for p in batch]
+            # Coalesce duplicate (pubkey, msg, sig) submissions: a vote
+            # gossiped by k peers lands k times inside one deadline
+            # window but costs one verifier lane; the verdict fans out
+            # to every waiting future.
+            pks: List[bytes] = []
+            msgs: List[bytes] = []
+            sigs: List[bytes] = []
+            index: dict = {}
+            slots: List[int] = []
+            for p in batch:
+                key = (p.pubkey, p.msg, p.sig)
+                idx = index.get(key)
+                if idx is None:
+                    idx = index[key] = len(pks)
+                    pks.append(p.pubkey)
+                    msgs.append(p.msg)
+                    sigs.append(p.sig)
+                slots.append(idx)
+            self.entries_coalesced += len(batch) - len(pks)
             try:
                 oks = self._verify_fn(pks, msgs, sigs)
             except Exception:
@@ -177,11 +194,11 @@ class VerifyScheduler:
                     except Exception:
                         oks = None
                 if oks is None:
-                    oks = [False] * len(batch)  # fail closed, never hang callers
-            if len(oks) != len(batch):  # misbehaving verifier: fail closed
-                oks = [False] * len(batch)
+                    oks = [False] * len(pks)  # fail closed, never hang callers
+            if len(oks) != len(pks):  # misbehaving verifier: fail closed
+                oks = [False] * len(pks)
             self.flushes += 1
             self.entries_verified += len(batch)
-            for p, ok in zip(batch, oks):
-                p.ok = bool(ok)
+            for p, idx in zip(batch, slots):
+                p.ok = bool(oks[idx])
                 p.done.set()

@@ -388,6 +388,41 @@ class OpsMetrics(_NopMixin):
             _name(s, "device_probe_seconds"),
             "Latency of half-open re-probe attempts, seconds.",
         )
+        # Validator-set precompute cache (ops/precompute.py).
+        self.precompute_hits = reg.counter(
+            _name(s, "precompute_hits_total"),
+            "Lanes served from the per-validator precompute table cache.",
+        )
+        self.precompute_misses = reg.counter(
+            _name(s, "precompute_misses_total"),
+            "Lanes that needed an in-kernel table build (cache miss).",
+        )
+        self.precompute_builds = reg.counter(
+            _name(s, "precompute_builds_total"),
+            "Host-side precompute table builds.",
+        )
+        self.precompute_evictions = reg.counter(
+            _name(s, "precompute_evictions_total"),
+            "Precompute table entries evicted by the LRU bound.",
+        )
+        self.precompute_invalidations = reg.counter(
+            _name(s, "precompute_invalidations_total"),
+            "Precompute table entries dropped on validator-set rotation.",
+        )
+        self.table_build_seconds = reg.histogram(
+            _name(s, "table_build_seconds"),
+            "Latency of host-side precompute table builds, seconds.",
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05),
+        )
+        # Digest-keyed verification result cache (ops/precompute.py).
+        self.result_cache_hits = reg.counter(
+            _name(s, "result_cache_hits_total"),
+            "Verifications answered from the digest-keyed result cache.",
+        )
+        self.result_cache_misses = reg.counter(
+            _name(s, "result_cache_misses_total"),
+            "Verifications that missed the digest-keyed result cache.",
+        )
 
 
 class StateMetrics(_NopMixin):

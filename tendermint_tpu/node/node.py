@@ -294,6 +294,10 @@ class Node:
         from tendermint_tpu.ops.device_policy import shared as _device_health
 
         _device_health.bind_metrics(ops_metrics)
+        # Same for the precompute + result caches (ops/precompute.py).
+        from tendermint_tpu.ops import precompute as _precompute
+
+        _precompute.bind_metrics(ops_metrics)
 
         # --- pools + executor (node.go:258-297) ------------------------------
         self.mempool = TxMempool(

@@ -144,6 +144,32 @@ def pt_select(cond: jnp.ndarray, p: Point, q: Point) -> Point:
     return tuple(fe_select(cond, a, b) for a, b in zip(p, q))  # type: ignore
 
 
+def niels_cneg(cond: jnp.ndarray, q: NielsPoint) -> NielsPoint:
+    """Per-lane conditional negation of a Niels point (cond: (N,) bool).
+
+    -(Y+X, Y-X, 2dT) = (Y-X, Y+X, -2dT): a component swap plus one
+    fe_neg — the cheap half of the signed-window trick, which lets the
+    window tables hold only the positive multiples [1..w]P.
+    """
+    yplusx, yminusx, td2 = q
+    return (
+        fe_select(cond, yminusx, yplusx),
+        fe_select(cond, yplusx, yminusx),
+        fe_select(cond, fe_neg(td2), td2),
+    )
+
+
+def cached_cneg(cond: jnp.ndarray, q: CachedPoint) -> CachedPoint:
+    """Per-lane conditional negation of a cached point; Z is unchanged."""
+    yplusx, yminusx, z, td2 = q
+    return (
+        fe_select(cond, yminusx, yplusx),
+        fe_select(cond, yplusx, yminusx),
+        z,
+        fe_select(cond, fe_neg(td2), td2),
+    )
+
+
 def pt_is_identity(p: Point) -> jnp.ndarray:
     """(N,) bool: X ≡ 0 and Y ≡ Z (projective identity test)."""
     x, y, z, _ = p

@@ -4,12 +4,24 @@ The device kernel verifies, for each lane i, the cofactored equation
 
     [8]([s_i]B - R_i - [k_i]A_i) == identity
 
-with a shared-doubling (Straus) double-scalar multiplication: 64 4-bit
-windows, per-window additions from a constant Niels basepoint table
-(7-mul mixed adds) and a per-lane table of [0..15](-A_i). All lanes
-execute the same 64-step loop, so the computation is pure SIMD over the
-batch — the TPU analog of the reference's CPU multi-scalar batch verify
+with a shared-doubling (Straus) double-scalar multiplication: 64
+*signed* 4-bit windows (digits in [-8, 8)), per-window additions from a
+constant Niels basepoint table of [1..8]B (7-mul mixed adds plus a
+conditional negation at select) and a per-lane table of [1..8](-A_i).
+Signed windows halve both the per-lane table build (7 chained adds
+instead of 14) and the broadcast-select bandwidth of the window loop —
+the per-window memory hot spot. All lanes execute the same 64-step
+loop, so the computation is pure SIMD over the batch — the TPU analog
+of the reference's CPU multi-scalar batch verify
 (crypto/ed25519/ed25519.go:198-233, types/validation.go:154).
+
+Two kernel entry points: :func:`verify_kernel` decompresses A and
+builds the lane tables on device; :func:`verify_kernel_tables` accepts
+a gathered ``(8, 4, 32, N)`` table input from the validator-set-aware
+precompute cache (ops/precompute.py) and skips both. verify_batch
+partitions lanes between them, consults the digest-keyed result cache
+first, and double-buffers chunk dispatch (host prep of chunk i+1
+overlaps the kernel of chunk i).
 
 Layout is transfer-minimal: the host uploads only the raw 32-byte
 strings (A, R, S, and the SHA-512 challenge k reduced mod L) as uint8;
@@ -48,8 +60,13 @@ _BUCKETS = [64, 256, 1024, CHUNK]
 # --- constant basepoint table (host precompute, Niels form) -----------------
 
 
-def _build_b_niels_table(width: int = 16) -> np.ndarray:
-    """(width, 3, 32) f32: [0..width-1]B as (Y+X, Y-X, 2dT), Z=1."""
+def _build_b_niels_table(width: int = 8) -> np.ndarray:
+    """(width, 3, 32) f32: [1..width]B as (Y+X, Y-X, 2dT), Z=1.
+
+    Signed windows select |digit| from the positive multiples and
+    negate at select time; digit 0 is an identity fixup, so no row is
+    spent on it.
+    """
     from tendermint_tpu.crypto import ed25519_ref as ref
 
     out = np.zeros((width, 3, field.NLIMBS), dtype=np.float32)
@@ -60,14 +77,11 @@ def _build_b_niels_table(width: int = 16) -> np.ndarray:
         zinv = pow(z_, p_mod - 2, p_mod)
         return (x_ * zinv % p_mod, y_ * zinv % p_mod)
 
+    acc = ref.B_POINT
     for i in range(width):
-        if i == 0:
-            x, y = 0, 1
-        else:
-            acc = ref.B_POINT
-            for _ in range(i - 1):
-                acc = ref.pt_add(acc, ref.B_POINT)
-            x, y = affine(acc)
+        if i:
+            acc = ref.pt_add(acc, ref.B_POINT)
+        x, y = affine(acc)
         out[i, 0] = field.int_to_limbs((y + x) % p_mod)
         out[i, 1] = field.int_to_limbs((y - x) % p_mod)
         out[i, 2] = field.int_to_limbs(2 * field.D * x * y % p_mod)
@@ -93,7 +107,12 @@ def _strip_sign(y: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def _to_windows(raw: jnp.ndarray) -> jnp.ndarray:
-    """(N, 32) uint8 scalars (LE) -> (64, N) f32 4-bit digits, MSB first."""
+    """(N, 32) uint8 scalars (LE) -> (64, N) f32 4-bit digits, MSB first.
+
+    Unsigned digit split; the window loop itself runs on the signed
+    recode (:func:`_to_windows_signed`) — this stays as the layout
+    primitive and documentation of the MSB-first interleave.
+    """
     b = raw.astype(jnp.float32).T  # (32, N)
     hi = jnp.floor(b * (1.0 / 16.0))
     lo = b - 16.0 * hi
@@ -101,33 +120,85 @@ def _to_windows(raw: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([hi[::-1], lo[::-1]], axis=1).reshape(2 * field.NLIMBS, -1)
 
 
+def _to_windows_signed(raw: jnp.ndarray) -> jnp.ndarray:
+    """(N, 32) uint8 scalars (LE) -> (64, N) f32 signed digits in [-8, 8).
+
+    Recoding: z = x + 0x88...88 (add 136 to every byte, ripple the
+    carries), then digit_i = window_i(z) - 8, so x = sum d_i 16^i with
+    every d_i in [-8, 7] — no carry chain inside the window loop. Exact
+    for x < 2^253 (both s and the reduced challenge k are < L < 2^253);
+    a non-canonical s >= 2^253 drops its carry-out and yields a
+    wrong-but-well-defined verdict that the host-side s < L check
+    already rejects. All intermediates stay exact in f32 (<= 392).
+    """
+    b = raw.astype(jnp.float32).T  # (32, N)
+    carry = jnp.zeros_like(b[0])
+    z = []
+    for i in range(field.NLIMBS):  # 32-step ripple, unrolled at trace
+        t = b[i] + 136.0 + carry
+        carry = jnp.floor(t * (1.0 / 256.0))
+        z.append(t - 256.0 * carry)
+    zb = jnp.stack(z)  # (32, N), carry-out dropped
+    hi = jnp.floor(zb * (1.0 / 16.0))
+    lo = zb - 16.0 * hi
+    win = jnp.stack([hi[::-1], lo[::-1]], axis=1).reshape(
+        2 * field.NLIMBS, -1
+    )
+    return win - 8.0
+
+
 def _select_b_niels(digit: jnp.ndarray, table: jnp.ndarray) -> curve.NielsPoint:
-    """digit: (N,) f32 in [0,16); table: (16, 3, 32) const -> Niels point."""
+    """digit: (N,) f32 in [-8, 8); table: (8, 3, 32) const [1..8]B.
+
+    One-hot on |digit| against half the rows of the unsigned scheme,
+    identity fixup for digit 0 (Niels identity is (1, 1, 0): add the
+    miss mask into limb 0), conditional negation for digit < 0.
+    """
+    absd = jnp.abs(digit)
     onehot = (
-        jnp.arange(16, dtype=jnp.float32)[:, None] == digit[None, :]
-    ).astype(jnp.float32)  # (16, N)
+        jnp.arange(1.0, 9.0, dtype=jnp.float32)[:, None] == absd[None, :]
+    ).astype(jnp.float32)  # (8, N)
     sel = jnp.einsum("tn,tcl->cln", onehot, table)
-    return (sel[0], sel[1], sel[2])
+    miss = (absd == 0.0).astype(jnp.float32)
+    yplusx = sel[0].at[0].add(miss)
+    yminusx = sel[1].at[0].add(miss)
+    return curve.niels_cneg(digit < 0.0, (yplusx, yminusx, sel[2]))
 
 
 def _select_lane_cached(digit: jnp.ndarray, table: jnp.ndarray) -> curve.CachedPoint:
-    """digit: (N,); table: (16, 4, 32, N) cached-form per-lane table."""
+    """digit: (N,) in [-8, 8); table: (8, 4, 32, N) cached [1..8]p.
+
+    The broadcast select over the per-lane table is the window loop's
+    memory hot spot — signed digits halve the rows it reads. Cached
+    identity is (1, 1, 1, 0), restored via the digit-0 fixup.
+    """
+    absd = jnp.abs(digit)
     onehot = (
-        jnp.arange(16, dtype=jnp.float32)[:, None] == digit[None, :]
+        jnp.arange(1.0, 9.0, dtype=jnp.float32)[:, None] == absd[None, :]
     ).astype(jnp.float32)
     sel = (onehot[:, None, None, :] * table).sum(axis=0)
-    return (sel[0], sel[1], sel[2], sel[3])
+    miss = (absd == 0.0).astype(jnp.float32)
+    yplusx = sel[0].at[0].add(miss)
+    yminusx = sel[1].at[0].add(miss)
+    z = sel[2].at[0].add(miss)
+    return curve.cached_cneg(digit < 0.0, (yplusx, yminusx, z, sel[3]))
+
+
+TABLE_WIDTH = 8  # rows of the per-lane signed-window table: [1..8](-A)
 
 
 def _build_lane_table(p: curve.Point) -> jnp.ndarray:
-    """(16, 4, 32, N) cached-form table of [0..15]p.
+    """(8, 4, 32, N) cached-form table of [1..8]p.
 
     Chained complete additions build the extended multiples (lax.scan
     keeps the traced graph to one pt_add); the conversion to cached form
-    (Y+X, Y-X, Z, 2dT) batches the 2d pre-scale of all 16 entries into a
-    single wide multiply so the window loop's adds need none.
+    (Y+X, Y-X, Z, 2dT) batches the 2d pre-scale of all 8 entries into a
+    single wide multiply so the window loop's adds need none. Signed
+    windows spend no rows on 0 or the negative multiples, halving the
+    14-add chain of the unsigned scheme.
     """
     n = p[0].shape[1]
+    w = TABLE_WIDTH
     cached_p = curve.pt_to_cached(p)
     p_stacked = jnp.stack(p)
 
@@ -137,23 +208,22 @@ def _build_lane_table(p: curve.Point) -> jnp.ndarray:
         )
         return nxt, nxt
 
-    _, rows = jax.lax.scan(step, p_stacked, None, length=14)
-    ext = jnp.concatenate(
-        [jnp.stack(curve.pt_identity(n))[None], p_stacked[None], rows], axis=0
-    )  # (16, 4, 32, N) extended
+    _, rows = jax.lax.scan(step, p_stacked, None, length=w - 1)
+    ext = jnp.concatenate([p_stacked[None], rows], axis=0)
+    # (8, 4, 32, N) extended
     x, y, z, t = ext[:, 0], ext[:, 1], ext[:, 2], ext[:, 3]
-    # one wide 2d*T multiply across all 16 entries (lanes folded in)
-    t_flat = t.transpose(1, 0, 2).reshape(field.NLIMBS, 16 * n)
-    td2 = field.fe_mul_const(t_flat, field.D2_FE).reshape(field.NLIMBS, 16, n)
+    # one wide 2d*T multiply across all 8 entries (lanes folded in)
+    t_flat = t.transpose(1, 0, 2).reshape(field.NLIMBS, w * n)
+    td2 = field.fe_mul_const(t_flat, field.D2_FE).reshape(field.NLIMBS, w, n)
     td2 = td2.transpose(1, 0, 2)
     yplusx = field.fe_add(
-        y.transpose(1, 0, 2).reshape(field.NLIMBS, 16 * n),
-        x.transpose(1, 0, 2).reshape(field.NLIMBS, 16 * n),
-    ).reshape(field.NLIMBS, 16, n).transpose(1, 0, 2)
+        y.transpose(1, 0, 2).reshape(field.NLIMBS, w * n),
+        x.transpose(1, 0, 2).reshape(field.NLIMBS, w * n),
+    ).reshape(field.NLIMBS, w, n).transpose(1, 0, 2)
     yminusx = field.fe_sub(
-        y.transpose(1, 0, 2).reshape(field.NLIMBS, 16 * n),
-        x.transpose(1, 0, 2).reshape(field.NLIMBS, 16 * n),
-    ).reshape(field.NLIMBS, 16, n).transpose(1, 0, 2)
+        y.transpose(1, 0, 2).reshape(field.NLIMBS, w * n),
+        x.transpose(1, 0, 2).reshape(field.NLIMBS, w * n),
+    ).reshape(field.NLIMBS, w, n).transpose(1, 0, 2)
     return jnp.stack([yplusx, yminusx, z, td2], axis=1)
 
 
@@ -165,21 +235,17 @@ def _dbl_step(_, acc_stacked):
     )
 
 
-def straus_sb_minus_ka(
-    a_pt: curve.Point, s_win: jnp.ndarray, k_win: jnp.ndarray
+def _straus_core(
+    a_table: jnp.ndarray, s_win: jnp.ndarray, k_win: jnp.ndarray
 ) -> curve.Point:
-    """Shared-doubling double-scalar core: [s]B - [k]A per lane.
+    """64-step shared-doubling window loop over a prebuilt lane table.
 
-    The same 64-step window loop serves both signature schemes on this
-    curve — ed25519 (below) and the schnorrkel/ristretto verifier
-    (ops/sr25519_batch.py): their verification equations are both
-    instances of [s]B - [k]A - R == identity-class.
+    a_table: (8, 4, 32, N) cached-form [1..8](-A) — either built on
+    device (:func:`straus_sb_minus_ka`) or gathered from the host-side
+    precompute cache (:func:`verify_kernel_tables`).
     """
-    nn = a_pt[0].shape[1]
-    neg_a = curve.pt_neg(a_pt)
-    a_table = _build_lane_table(neg_a)
+    nn = a_table.shape[3]
     b_table = jnp.asarray(B_NIELS)
-
     init = jnp.stack(curve.pt_identity(nn))
 
     def body(i, acc_stacked):
@@ -195,6 +261,32 @@ def straus_sb_minus_ka(
     return (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
 
 
+def straus_sb_minus_ka(
+    a_pt: curve.Point, s_win: jnp.ndarray, k_win: jnp.ndarray
+) -> curve.Point:
+    """Shared-doubling double-scalar core: [s]B - [k]A per lane.
+
+    The same 64-step window loop serves both signature schemes on this
+    curve — ed25519 (below) and the schnorrkel/ristretto verifier
+    (ops/sr25519_batch.py): their verification equations are both
+    instances of [s]B - [k]A - R == identity-class. s_win/k_win are
+    signed digits from :func:`_to_windows_signed`.
+    """
+    neg_a = curve.pt_neg(a_pt)
+    return _straus_core(_build_lane_table(neg_a), s_win, k_win)
+
+
+def _finish_verify(
+    acc: curve.Point, r_pt: curve.Point, ok: jnp.ndarray
+) -> jnp.ndarray:
+    """[s]B - [k]A computed; subtract R, multiply by cofactor 8, test
+    identity, mask structurally-invalid lanes."""
+    acc = curve.pt_add(acc, curve.pt_neg(r_pt))
+    acc_stacked = jax.lax.fori_loop(0, 3, _dbl_step, jnp.stack(acc))
+    acc = (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
+    return curve.pt_is_identity(acc) & ok
+
+
 def verify_kernel(
     pk_bytes: jnp.ndarray,
     r_bytes: jnp.ndarray,
@@ -204,8 +296,8 @@ def verify_kernel(
     """(N,32)x4 uint8 -> (N,) bool."""
     a_y, a_sign = _strip_sign(_bytes_to_fe(pk_bytes))
     r_y, r_sign = _strip_sign(_bytes_to_fe(r_bytes))
-    s_win = _to_windows(s_bytes)
-    k_win = _to_windows(k_bytes)
+    s_win = _to_windows_signed(s_bytes)
+    k_win = _to_windows_signed(k_bytes)
 
     # Decompress A and R as one 2N batch: halves the decompression HLO
     # and doubles its SIMD width.
@@ -219,11 +311,30 @@ def verify_kernel(
     a_ok, r_ok = both_ok[:nn], both_ok[nn:]
 
     acc = straus_sb_minus_ka(a_pt, s_win, k_win)
-    # [s]B - [k]A computed; subtract R, multiply by cofactor 8, test identity.
-    acc = curve.pt_add(acc, curve.pt_neg(r_pt))
-    acc_stacked = jax.lax.fori_loop(0, 3, _dbl_step, jnp.stack(acc))
-    acc = (acc_stacked[0], acc_stacked[1], acc_stacked[2], acc_stacked[3])
-    return curve.pt_is_identity(acc) & a_ok & r_ok
+    return _finish_verify(acc, r_pt, a_ok & r_ok)
+
+
+def verify_kernel_tables(
+    a_table: jnp.ndarray,
+    a_ok: jnp.ndarray,
+    r_bytes: jnp.ndarray,
+    s_bytes: jnp.ndarray,
+    k_bytes: jnp.ndarray,
+) -> jnp.ndarray:
+    """Cache-hit entry point: the lane tables arrive prebuilt.
+
+    a_table: (8, 4, 32, N) uint8 — gathered [1..8](-A) cached-form
+    columns from ops/precompute.py (canonical limbs, so uint8 on the
+    wire: 1/4 the H2D bytes of f32). a_ok: (N,) uint8 decompression
+    verdicts from the same cache. Skips pt_decompress-of-A and
+    _build_lane_table entirely; only R is decompressed on device.
+    """
+    r_y, r_sign = _strip_sign(_bytes_to_fe(r_bytes))
+    s_win = _to_windows_signed(s_bytes)
+    k_win = _to_windows_signed(k_bytes)
+    r_pt, r_ok = curve.pt_decompress(r_y, r_sign)
+    acc = _straus_core(a_table.astype(jnp.float32), s_win, k_win)
+    return _finish_verify(acc, r_pt, (a_ok != 0) & r_ok)
 
 
 def _enable_persistent_cache() -> None:
@@ -262,6 +373,18 @@ def _compiled_kernel(n: int, backend: Optional[str], mul_impl: str = "vpu"):
     def run(pk, r, s, k):
         with field.pinned_mul_impl(mul_impl):
             return verify_kernel(pk, r, s, k)
+
+    return jax.jit(run, backend=backend)
+
+
+@lru_cache(maxsize=16)
+def _compiled_kernel_tables(n: int, backend: Optional[str], mul_impl: str = "vpu"):
+    """Compiled table-input verifier (cache-hit lanes); same keying
+    rules as :func:`_compiled_kernel`."""
+
+    def run(tab, ok, r, s, k):
+        with field.pinned_mul_impl(mul_impl):
+            return verify_kernel_tables(tab, ok, r, s, k)
 
     return jax.jit(run, backend=backend)
 
@@ -305,24 +428,25 @@ def active_impl(backend: Optional[str] = None) -> str:
     return "pallas" if _platform(backend) in ("tpu", "axon") else "xla"
 
 
-def _run_chunk(inputs: dict, lo: int, hi: int, backend: Optional[str]):
-    """Dispatch one padded chunk, preferring Pallas on TPU backends."""
+def _run_chunk(inputs: dict, backend: Optional[str]):
+    """Dispatch one padded legacy chunk, preferring Pallas on TPU."""
     global _PALLAS_BROKEN
     from tendermint_tpu.ops import fault_injection
 
     fault_injection.fire("ed25519.chunk")
     args = (
-        jnp.asarray(inputs["pk"][lo:hi]),
-        jnp.asarray(inputs["r"][lo:hi]),
-        jnp.asarray(inputs["s"][lo:hi]),
-        jnp.asarray(inputs["k"][lo:hi]),
+        jnp.asarray(inputs["pk"]),
+        jnp.asarray(inputs["r"]),
+        jnp.asarray(inputs["s"]),
+        jnp.asarray(inputs["k"]),
     )
+    m = inputs["pk"].shape[0]
     impl = active_impl(backend)
     if impl == "pallas":
         try:
             from tendermint_tpu.ops import pallas_verify
 
-            return pallas_verify.compiled_verify(hi - lo)(*args)
+            return pallas_verify.compiled_verify(m)(*args)
         except Exception as exc:  # compile/runtime failure -> XLA graph
             _PALLAS_BROKEN = True
             import warnings
@@ -334,7 +458,38 @@ def _run_chunk(inputs: dict, lo: int, hi: int, backend: Optional[str]):
     # field-level default (field32.set_mul_impl / TENDERMINT_TPU_FIELD_MUL)
     # is honored otherwise.
     mul_impl = "mxu" if impl == "mxu" else field.get_mul_impl()
-    return _compiled_kernel(hi - lo, backend, mul_impl)(*args)
+    return _compiled_kernel(m, backend, mul_impl)(*args)
+
+
+def _run_chunk_tables(inputs: dict, backend: Optional[str]):
+    """Dispatch one padded cache-hit chunk through the table kernel."""
+    global _PALLAS_BROKEN
+    from tendermint_tpu.ops import fault_injection
+
+    fault_injection.fire("ed25519.chunk")
+    args = (
+        jnp.asarray(inputs["tab"]),
+        jnp.asarray(inputs["ok"]),
+        jnp.asarray(inputs["r"]),
+        jnp.asarray(inputs["s"]),
+        jnp.asarray(inputs["k"]),
+    )
+    m = inputs["r"].shape[0]
+    impl = active_impl(backend)
+    if impl == "pallas":
+        try:
+            from tendermint_tpu.ops import pallas_verify
+
+            return pallas_verify.compiled_verify_tables(m)(*args)
+        except Exception as exc:  # compile/runtime failure -> XLA graph
+            _PALLAS_BROKEN = True
+            import warnings
+
+            warnings.warn(
+                f"pallas table verifier failed ({exc!r}); falling back to XLA graph"
+            )
+    mul_impl = "mxu" if impl == "mxu" else field.get_mul_impl()
+    return _compiled_kernel_tables(m, backend, mul_impl)(*args)
 
 
 # --- host-side preparation --------------------------------------------------
@@ -370,6 +525,32 @@ def _pad_k() -> bytes:
             [_PAD_SIG[:32] + _PAD_PK + _PAD_MSG]
         )[0]
     return _PAD_K
+
+
+# Padding rows as ready-made (1, 32) uint8 arrays, decoded once instead
+# of np.frombuffer over the pad triple on every padded prepare call.
+_PAD_ROWS: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = None
+_PAD_TABLE: Optional[np.ndarray] = None
+
+
+def _pad_rows() -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    global _PAD_ROWS
+    if _PAD_ROWS is None:
+        _PAD_ROWS = tuple(
+            np.frombuffer(b, dtype=np.uint8).reshape(1, 32).copy()
+            for b in (_PAD_PK, _PAD_SIG[:32], _PAD_SIG[32:], _pad_k())
+        )
+    return _PAD_ROWS
+
+
+def _pad_table() -> np.ndarray:
+    """(8, 4, 32) uint8 signed-window table of the pad pubkey."""
+    global _PAD_TABLE
+    if _PAD_TABLE is None:
+        from tendermint_tpu.ops import precompute
+
+        _PAD_TABLE = precompute.build_table(_PAD_PK)[0]
+    return _PAD_TABLE
 
 
 def canonical_lt(arr_le: np.ndarray, bound_be: np.ndarray) -> np.ndarray:
@@ -440,13 +621,52 @@ def prepare_batch(
 
     m = pad_to if pad_to is not None else _bucket(n)
     if m > n:
-        pad = np.zeros((m - n, 32), dtype=np.uint8)
-        pk_arr = np.concatenate([pk_arr, pad + np.frombuffer(_PAD_PK, dtype=np.uint8)])
-        r_arr = np.concatenate([r_arr, pad + np.frombuffer(_PAD_SIG[:32], dtype=np.uint8)])
-        s_arr = np.concatenate([s_arr, pad + np.frombuffer(_PAD_SIG[32:], dtype=np.uint8)])
-        k_arr = np.concatenate([k_arr, pad + np.frombuffer(_pad_k(), dtype=np.uint8)])
+        pk_row, r_row, s_row, k_row = _pad_rows()
+        reps = (m - n, 1)
+        pk_arr = np.concatenate([pk_arr, np.tile(pk_row, reps)])
+        r_arr = np.concatenate([r_arr, np.tile(r_row, reps)])
+        s_arr = np.concatenate([s_arr, np.tile(s_row, reps)])
+        k_arr = np.concatenate([k_arr, np.tile(k_row, reps)])
 
     inputs = dict(pk=pk_arr, r=r_arr, s=s_arr, k=k_arr)
+    return inputs, host_ok
+
+
+def _prep_table_chunk(
+    pks: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    tabs: Sequence[np.ndarray],
+    oks: Sequence[bool],
+    pad_to: int,
+) -> Tuple[dict, np.ndarray]:
+    """Host prep for a cache-hit chunk: hash challenges, stack the
+    gathered per-key table columns into the kernel's (8, 4, 32, M)
+    uint8 input. Lengths are pre-validated by the caller (ill-formed
+    lanes stay on the legacy path)."""
+    from tendermint_tpu.crypto.hashing import reduce_mod_l, sha512_batch_prefixed
+
+    n = len(pks)
+    pk_arr = np.frombuffer(b"".join(pks), dtype=np.uint8).reshape(n, 32)
+    sig_arr = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(n, 64)
+    r_arr, s_arr = sig_arr[:, :32], sig_arr[:, 32:]
+    host_ok = _s_canonical(s_arr)
+    prefix = np.concatenate([r_arr, pk_arr], axis=1)  # (n, 64) = R || A
+    k_arr = reduce_mod_l(sha512_batch_prefixed(prefix, list(msgs)))
+    tab = np.stack(tabs)  # (n, 8, 4, 32) uint8
+    a_ok = np.fromiter(oks, dtype=bool, count=n).astype(np.uint8)
+    if pad_to > n:
+        _, r_row, s_row, k_row = _pad_rows()
+        reps = (pad_to - n, 1)
+        r_arr = np.concatenate([r_arr, np.tile(r_row, reps)])
+        s_arr = np.concatenate([s_arr, np.tile(s_row, reps)])
+        k_arr = np.concatenate([k_arr, np.tile(k_row, reps)])
+        tab = np.concatenate(
+            [tab, np.broadcast_to(_pad_table()[None], (pad_to - n, TABLE_WIDTH, 4, 32))]
+        )
+        a_ok = np.concatenate([a_ok, np.ones(pad_to - n, dtype=np.uint8)])
+    tab = np.ascontiguousarray(tab.transpose(1, 2, 3, 0))  # (8, 4, 32, M)
+    inputs = dict(tab=tab, ok=a_ok, r=r_arr, s=s_arr, k=k_arr)
     return inputs, host_ok
 
 
@@ -458,15 +678,40 @@ def _host_verify_lanes(
     hi: int,
 ) -> np.ndarray:
     """CPU oracle over lanes [lo, hi) of the original (unpadded) batch."""
+    return _host_verify_rows(pubkeys, msgs, sigs, range(lo, hi))
+
+
+def _host_verify_rows(
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    rows,
+) -> np.ndarray:
+    """CPU oracle over an arbitrary row subset of the original batch."""
     from tendermint_tpu.crypto.ed25519_ref import verify_zip215
 
     return np.array(
-        [
-            verify_zip215(pubkeys[i], msgs[i], sigs[i])
-            for i in range(lo, hi)
-        ],
+        [verify_zip215(pubkeys[i], msgs[i], sigs[i]) for i in rows],
         dtype=bool,
     )
+
+
+class _Job:
+    """One padded chunk of the batch: either legacy (build tables on
+    device) or cache-hit (gathered table input). ``rows`` are original
+    batch indices; the padded tail is sliced off at scatter time."""
+
+    __slots__ = ("kind", "rows", "prepped", "out")
+
+    def __init__(self, kind: str, rows: np.ndarray):
+        self.kind = kind
+        self.rows = rows
+        self.prepped = None  # (inputs dict, host_ok) once prep ran
+        self.out = None  # in-flight device result
+
+
+def _chunk_rows(rows: np.ndarray) -> List[np.ndarray]:
+    return [rows[lo : lo + CHUNK] for lo in range(0, len(rows), CHUNK)]
 
 
 def verify_batch(
@@ -480,11 +725,20 @@ def verify_batch(
     The entry point behind crypto.Ed25519BatchVerifier — reference
     contract crypto/crypto.go:58-76 / crypto/ed25519/ed25519.go:198-233.
 
-    Batches larger than CHUNK are split and their kernel calls enqueued
-    back-to-back so H2D transfer of chunk j+1 overlaps compute of
-    chunk j (JAX async dispatch).
+    The amortized pipeline (ops/precompute.py):
 
-    Device failures degrade per CHUNK, not per process: a chunk whose
+    1. The digest-keyed result cache answers lanes verified before
+       (identical last-commit votes at height H+1, vote floods).
+    2. Remaining lanes are partitioned: keys with a cached (or
+       eligible-to-build) signed-window table take the table kernel,
+       which skips per-lane decompression and table building; the rest
+       take the legacy build-on-device kernel.
+    3. Chunks are double-buffered: the kernel for chunk i is enqueued
+       (JAX async dispatch), then chunk i+1's host prep — challenge
+       hashing and table gather — runs while the device crunches
+       chunk i, so host prep and H2D overlap device compute.
+
+    Device failures degrade per chunk, not per process: a chunk whose
     dispatch or materialization fails is re-verified on the CPU oracle
     while the rest of the batch stays on the device (if the health
     state machine — ops/device_policy.py — still admits it). A batch
@@ -492,70 +746,155 @@ def verify_batch(
     state machine alone decides when the device is cooling down or
     disabled, and it recovers via half-open probe batches.
     """
-    from tendermint_tpu.ops import fault_injection
-    from tendermint_tpu.ops.device_policy import shared as health
+    from tendermint_tpu.ops import precompute
 
     n = len(pubkeys)
     if n == 0:
         return []
+    if not precompute.result_cache_enabled():
+        return [bool(v) for v in _verify_uncached(pubkeys, msgs, sigs, backend)]
+    verdicts = np.zeros(n, dtype=bool)
+    pending = []
+    for i in range(n):
+        v = precompute.results.get(pubkeys[i], msgs[i], sigs[i])
+        if v is None:
+            pending.append(i)
+        else:
+            verdicts[i] = v
+    if pending:
+        if len(pending) == n:
+            sub = (pubkeys, msgs, sigs)
+        else:
+            sub = (
+                [pubkeys[i] for i in pending],
+                [msgs[i] for i in pending],
+                [sigs[i] for i in pending],
+            )
+        out = _verify_uncached(sub[0], sub[1], sub[2], backend)
+        for j, i in enumerate(pending):
+            verdicts[i] = out[j]
+            precompute.results.put(pubkeys[i], msgs[i], sigs[i], bool(out[j]))
+    return [bool(v) for v in verdicts]
+
+
+def _verify_uncached(
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    backend: Optional[str] = None,
+) -> np.ndarray:
+    """Device verification of lanes the result cache could not answer."""
+    from tendermint_tpu.ops import fault_injection, precompute
+    from tendermint_tpu.ops.device_policy import shared as health
+
+    n = len(pubkeys)
     attempt = health.begin_attempt("ed25519")
     if attempt is None:
         # DISABLED, or cooling down (another caller may hold the probe
         # slot). Instant answer — the circuit breaker never blocks.
         health.count_fallback("ed25519", n)
-        return list(_host_verify_lanes(pubkeys, msgs, sigs, 0, n))
+        return _host_verify_lanes(pubkeys, msgs, sigs, 0, n)
 
+    # Partition: lanes whose key has a cached (or eligible, host-built)
+    # table take the table kernel; ill-formed lanes must stay on the
+    # legacy path, whose slow-path prep handles bad lengths.
     try:
-        inputs, host_ok = prepare_batch(pubkeys, msgs, sigs, pad_to=_bucket(n))
-    except Exception as exc:
-        # Host prep failed before any device work. Never take the node
-        # down over infrastructure — degrade to the host oracle.
+        entries, has_table = precompute.tables.gather(pubkeys)
+    except Exception:  # cache trouble never blocks verification
+        entries, has_table = None, np.zeros(n, dtype=bool)
+    if entries is not None:
+        well_formed = np.fromiter(
+            (len(pk) == 32 and len(sg) == 64 for pk, sg in zip(pubkeys, sigs)),
+            dtype=bool,
+            count=n,
+        )
+        has_table &= well_formed
+    if entries is None or not has_table.any():
+        has_table = np.zeros(n, dtype=bool)
+        entries = None
+
+    jobs = [_Job("tables", rows) for rows in _chunk_rows(np.nonzero(has_table)[0])]
+    jobs += [_Job("legacy", rows) for rows in _chunk_rows(np.nonzero(~has_table)[0])]
+
+    def prep_job(job: _Job) -> Tuple[dict, np.ndarray]:
+        pks = [pubkeys[i] for i in job.rows]
+        ms = [msgs[i] for i in job.rows]
+        sgs = [sigs[i] for i in job.rows]
+        pad_to = _bucket(len(job.rows))
+        if job.kind == "tables":
+            return _prep_table_chunk(
+                pks,
+                ms,
+                sgs,
+                [entries[i][0] for i in job.rows],
+                [entries[i][1] for i in job.rows],
+                pad_to,
+            )
+        return prepare_batch(pks, ms, sgs, pad_to=pad_to)
+
+    results = np.ones(n, dtype=bool)
+    host_ok_all = np.ones(n, dtype=bool)
+
+    def note_prep_failure(job: _Job, exc: Exception) -> None:
+        nonlocal attempt
+        # Host prep failed before any device work for this job. Never
+        # take the node down over infrastructure — its lanes degrade to
+        # the host oracle at collect time.
         health.record_failure(exc, attempt)
+        attempt = None
         import warnings
 
         warnings.warn(
-            f"batch prepare failed ({exc!r}); host fallback "
-            f"(device state={health.state})"
+            f"chunk prepare failed ({exc!r}); CPU fallback for "
+            f"{len(job.rows)} lanes (device state={health.state})"
         )
-        health.count_fallback("ed25519", n)
-        return list(_host_verify_lanes(pubkeys, msgs, sigs, 0, n))
 
-    m = inputs["pk"].shape[0]
-    # Dispatch phase: enqueue chunk kernels back-to-back; a chunk whose
-    # dispatch raises falls back to the host WITHOUT abandoning the
-    # remaining chunks (the health machine re-admits or refuses them).
-    chunks = []  # (lo, hi, device result or None)
-    for lo in range(0, m, CHUNK):
-        hi = min(lo + CHUNK, m)
-        if attempt is None:
-            attempt = health.begin_attempt("ed25519")
-        if attempt is None:
-            chunks.append((lo, hi, None))
-            continue
-        try:
-            chunks.append((lo, hi, _run_chunk(inputs, lo, hi, backend)))
-        except Exception as exc:
-            health.record_failure(exc, attempt)
-            attempt = None
-            import warnings
+    # Double-buffered dispatch: enqueue job j's kernel (async), then run
+    # job j+1's host prep while the device crunches job j.
+    for j, job in enumerate(jobs):
+        if j == 0:
+            try:
+                job.prepped = prep_job(job)
+            except Exception as exc:
+                note_prep_failure(job, exc)
+        if job.prepped is not None:
+            inputs, host_ok = job.prepped
+            host_ok_all[job.rows] = host_ok[: len(job.rows)]
+            if attempt is None:
+                attempt = health.begin_attempt("ed25519")
+            if attempt is not None:
+                try:
+                    runner = (
+                        _run_chunk_tables if job.kind == "tables" else _run_chunk
+                    )
+                    job.out = runner(inputs, backend)
+                except Exception as exc:
+                    health.record_failure(exc, attempt)
+                    attempt = None
+                    import warnings
 
-            warnings.warn(
-                f"device chunk [{lo}:{hi}] dispatch failed ({exc!r}); "
-                f"CPU fallback for the chunk (device state={health.state})"
-            )
-            chunks.append((lo, hi, None))
+                    warnings.warn(
+                        f"device chunk ({job.kind}, {len(job.rows)} lanes) "
+                        f"dispatch failed ({exc!r}); CPU fallback for the "
+                        f"chunk (device state={health.state})"
+                    )
+        if j + 1 < len(jobs):
+            nxt = jobs[j + 1]
+            try:
+                nxt.prepped = prep_job(nxt)
+            except Exception as exc:
+                note_prep_failure(nxt, exc)
 
     # Collect phase: JAX dispatch is async, so runtime errors can
     # surface at materialization; those too degrade per chunk.
-    results = np.ones(m, dtype=bool)
     fallback_lanes = 0
     device_chunks_ok = 0
-    for lo, hi, out in chunks:
+    for job in jobs:
         ok = None
-        if out is not None:
+        if job.out is not None:
             try:
                 fault_injection.fire("ed25519.collect")
-                ok = np.asarray(out)
+                ok = np.asarray(job.out)
                 device_chunks_ok += 1
             except Exception as exc:
                 health.record_failure(exc, attempt)
@@ -563,16 +902,18 @@ def verify_batch(
                 import warnings
 
                 warnings.warn(
-                    f"device chunk [{lo}:{hi}] failed at collect ({exc!r}); "
-                    f"CPU fallback for the chunk (device state={health.state})"
+                    f"device chunk ({job.kind}, {len(job.rows)} lanes) "
+                    f"failed at collect ({exc!r}); CPU fallback for the "
+                    f"chunk (device state={health.state})"
                 )
+        if not len(job.rows):
+            continue
         if ok is None:
-            ok = np.ones(hi - lo, dtype=bool)
-            top = min(hi, n)  # padded lanes need no host verify
-            if lo < top:
-                fallback_lanes += top - lo
-                ok[: top - lo] = _host_verify_lanes(pubkeys, msgs, sigs, lo, top)
-        results[lo:hi] = ok
+            fallback_lanes += len(job.rows)
+            results[job.rows] = _host_verify_rows(pubkeys, msgs, sigs, job.rows)
+            host_ok_all[job.rows] = True  # oracle verdicts are final
+        else:
+            results[job.rows] = ok[: len(job.rows)]
 
     if fallback_lanes:
         health.count_fallback("ed25519", fallback_lanes)
@@ -580,4 +921,4 @@ def verify_batch(
         # No failure consumed the attempt and device work round-tripped:
         # re-promote (clears DEGRADED, completes a half-open probe).
         health.record_success(attempt)
-    return [bool(v) for v in np.logical_and(results[:n], host_ok)]
+    return np.logical_and(results, host_ok_all)

@@ -16,9 +16,18 @@ equation [8]([s]B - R - [k]A) == identity.
 
 Field elements are ``(32, n)`` f32 values (limb-major, lanes minor) on
 a block of ``n`` signatures; the grid walks lane-blocks of the batch.
-One-hot table selects for the constant basepoint table are MXU
-matmuls (exact: both operands are small integers, see
-``_select_b``); the per-lane table lives in a VMEM scratch.
+The Straus loop uses *signed* 4-bit windows (digits in [-8, 8)): both
+tables hold only [1..8]P, selects negate conditionally (a component
+swap plus fe_neg) and restore the identity for digit 0 via a
+concat-style limb-0 fixup. One-hot selects for the constant basepoint
+table are MXU matmuls (exact: both operands are small integers); the
+per-lane table lives in an ``(8, 128, block)`` VMEM scratch — half the
+footprint and half the select bandwidth of the unsigned scheme.
+
+Two entry points: :func:`compiled_verify` builds the lane tables
+in-kernel; :func:`compiled_verify_tables` takes the gathered
+``(8, 4, 32, N)`` table input from the validator-set precompute cache
+(ops/precompute.py) and skips decompression of A and the table build.
 
 Reference semantics: crypto/ed25519/ed25519.go:24-31 (ZIP-215 verify
 options), crypto/ed25519/ed25519.go:198-233 (batch verifier),
@@ -350,6 +359,86 @@ def _unstack(v: jnp.ndarray) -> Point:
     return (v[0:32], v[32:64], v[64:96], v[96:128])
 
 
+def _signed_select_masks(d, n: int):
+    """d: (1, n) f32 signed digit in [-8, 8). Returns the one-hot over
+    [1..8]|d| ((8, n) f32), the digit-0 miss mask ((1, n) f32), and the
+    negate mask ((1, n) bool)."""
+    di = d.astype(jnp.int32)
+    absd = jnp.abs(di)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (8, n), 0) + 1
+    oh = (iota == absd).astype(jnp.float32)
+    miss = (absd == 0).astype(jnp.float32)
+    return oh, miss, di < 0
+
+
+def _straus_loop(tab_ref, swin_ref, kwin_ref, byp, bym, bt2, n: int) -> Point:
+    """64-window signed Straus loop: acc <- 16*acc + d_s*B + d_k*(-A).
+
+    tab_ref holds the [1..8](-A) cached rows ((8, 128, n) — VMEM
+    scratch or a pre-gathered input block); byp/bym/bt2 are the (32, 8)
+    limb columns of [1..8]B in affine Niels form.
+    """
+    dot = lambda m, oh: jax.lax.dot_general(
+        m, oh, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    def body(i, acc128):
+        acc = _unstack(acc128)
+        for _ in range(4):
+            acc = pt_double(acc)
+        ohs, miss_s, neg_s = _signed_select_masks(swin_ref[pl.ds(i, 1), :], n)
+        ohk, miss_k, neg_k = _signed_select_masks(kwin_ref[pl.ds(i, 1), :], n)
+        # Constant-table select: MXU matmul, exact (operands are
+        # integers <= 255 and {0,1}, both exactly representable in
+        # bf16, accumulation in f32). Digit 0 selects all-zero rows;
+        # the concat fixup restores the Niels identity (1, 1, 0) in
+        # limb 0, and curve32.niels_cneg handles the sign (component
+        # swap plus one fe_neg).
+        byp_s = dot(byp, ohs)
+        bym_s = dot(bym, ohs)
+        bt2_s = dot(bt2, ohs)
+        byp_s = jnp.concatenate([byp_s[0:1] + miss_s, byp_s[1:]], axis=0)
+        bym_s = jnp.concatenate([bym_s[0:1] + miss_s, bym_s[1:]], axis=0)
+        acc = pt_madd(
+            acc,
+            fe_select(neg_s, bym_s, byp_s),
+            fe_select(neg_s, byp_s, bym_s),
+            fe_select(neg_s, fe_neg(bt2_s), bt2_s),
+        )
+        # Per-lane table select: one-hot FMA over the 8 table rows,
+        # then the cached-identity (1, 1, 1, 0) fixup at stacked rows
+        # 0/32/64 and the cached_cneg swap.
+        sel = ohk[0][None, :] * tab_ref[0]
+        for t in range(1, 8):
+            sel = sel + ohk[t][None, :] * tab_ref[t]
+        sel = jnp.concatenate(
+            [
+                sel[0:1] + miss_k,
+                sel[1:32],
+                sel[32:33] + miss_k,
+                sel[33:64],
+                sel[64:65] + miss_k,
+                sel[65:128],
+            ],
+            axis=0,
+        )
+        c0, c1, c2, c3 = _unstack(sel)
+        acc = pt_add_cached(
+            acc,
+            (
+                fe_select(neg_k, c1, c0),
+                fe_select(neg_k, c0, c1),
+                c2,
+                fe_select(neg_k, fe_neg(c3), c3),
+            ),
+        )
+        return _stack(acc)
+
+    return _unstack(
+        jax.lax.fori_loop(0, NWINDOWS, body, _stack(pt_identity(n)), unroll=False)
+    )
+
+
 def _verify_kernel(
     ay_ref,
     asign_ref,
@@ -364,9 +453,9 @@ def _verify_kernel(
     out_ref,
     tab_ref,
 ):
-    """One lane-block: decompress, build [0..15](-A) table, Straus loop.
+    """One lane-block: decompress, build [1..8](-A) table, Straus loop.
 
-    tab_ref: (16, 128, BLOCK) VMEM scratch of cached-form multiples.
+    tab_ref: (8, 128, BLOCK) VMEM scratch of cached-form multiples.
     """
     n = ay_ref.shape[1]
     d_c = consts_ref[:, 0:1]
@@ -381,53 +470,61 @@ def _verify_kernel(
     r_pt = tuple(c[:, n:] for c in pt2)
     a_ok, r_ok = ok2[:, :n], ok2[:, n:]
 
-    # Per-lane cached table of [0..15](-A) in VMEM scratch.
+    # Per-lane cached table of [1..8](-A) in VMEM scratch (row t holds
+    # (t+1)(-A); the identity for digit 0 is synthesized at select).
     neg_a = pt_neg(a_pt)
     cp = pt_to_cached(neg_a, d2_c)
-    tab_ref[0] = _stack(pt_to_cached(pt_identity(n), d2_c))
-    tab_ref[1] = _stack(cp)
+    tab_ref[0] = _stack(cp)
 
     def tbody(i, acc128):
         nxt = pt_add_cached(_unstack(acc128), cp)
         tab_ref[pl.ds(i, 1)] = _stack(pt_to_cached(nxt, d2_c))[None]
         return _stack(nxt)
 
-    jax.lax.fori_loop(2, 16, tbody, _stack(neg_a), unroll=False)
+    jax.lax.fori_loop(1, 8, tbody, _stack(neg_a), unroll=False)
 
-    byp = byp_ref[:, :].T  # (32, 16)
+    byp = byp_ref[:, :].T  # (32, 8)
     bym = bym_ref[:, :].T
     bt2 = bt2_ref[:, :].T
-
-    def body(i, acc128):
-        acc = _unstack(acc128)
-        for _ in range(4):
-            acc = pt_double(acc)
-        sd = swin_ref[pl.ds(i, 1), :].astype(jnp.int32)  # (1, n)
-        kd = kwin_ref[pl.ds(i, 1), :].astype(jnp.int32)
-        iota = jax.lax.broadcasted_iota(jnp.int32, (16, n), 0)
-        ohs = (iota == sd).astype(jnp.float32)  # (16, n)
-        ohk = (iota == kd).astype(jnp.float32)
-        # Constant-table select: MXU matmul, exact (operands are
-        # integers <= 255 and {0,1}, both exactly representable in
-        # bf16, accumulation in f32).
-        dot = lambda m, oh: jax.lax.dot_general(
-            m, oh, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        acc = pt_madd(acc, dot(byp, ohs), dot(bym, ohs), dot(bt2, ohs))
-        # Per-lane table select: one-hot FMA over the 16 scratch rows.
-        sel = ohk[0][None, :] * tab_ref[0]
-        for t in range(1, 16):
-            sel = sel + ohk[t][None, :] * tab_ref[t]
-        acc = pt_add_cached(acc, _unstack(sel))
-        return _stack(acc)
-
-    acc128 = jax.lax.fori_loop(
-        0, NWINDOWS, body, _stack(pt_identity(n)), unroll=False
-    )
-    acc = pt_add_cached(_unstack(acc128), pt_to_cached(pt_neg(r_pt), d2_c))
+    acc = _straus_loop(tab_ref, swin_ref, kwin_ref, byp, bym, bt2, n)
+    acc = pt_add_cached(acc, pt_to_cached(pt_neg(r_pt), d2_c))
     for _ in range(3):
         acc = pt_double(acc)
     ok = pt_is_identity(acc) & a_ok & r_ok
+    out_ref[:, :] = ok.astype(jnp.float32)
+
+
+def _verify_tables_kernel(
+    tab_ref,
+    aok_ref,
+    ry_ref,
+    rsign_ref,
+    swin_ref,
+    kwin_ref,
+    byp_ref,
+    bym_ref,
+    bt2_ref,
+    consts_ref,
+    out_ref,
+):
+    """Table-input variant: the [1..8](-A) cached rows arrive
+    pre-gathered from the validator-set precompute cache as an
+    (8, 128, block) f32 input, so only R is decompressed, no scratch is
+    needed, and the per-lane table build (the dominant fixed cost per
+    lane) is skipped entirely."""
+    n = ry_ref.shape[1]
+    d_c = consts_ref[:, 0:1]
+    m1_c = consts_ref[:, 1:2]
+    d2_c = consts_ref[:, 2:3]
+    r_pt, r_ok = pt_decompress(ry_ref[:, :], rsign_ref[:, :], d_c, m1_c)
+    byp = byp_ref[:, :].T  # (32, 8)
+    bym = bym_ref[:, :].T
+    bt2 = bt2_ref[:, :].T
+    acc = _straus_loop(tab_ref, swin_ref, kwin_ref, byp, bym, bt2, n)
+    acc = pt_add_cached(acc, pt_to_cached(pt_neg(r_pt), d2_c))
+    for _ in range(3):
+        acc = pt_double(acc)
+    ok = pt_is_identity(acc) & (aok_ref[:, :] != 0.0) & r_ok
     out_ref[:, :] = ok.astype(jnp.float32)
 
 
@@ -437,7 +534,7 @@ def _verify_kernel(
 def _b_tables() -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     from tendermint_tpu.ops import ed25519_batch
 
-    t = ed25519_batch.B_NIELS  # (16, 3, 32)
+    t = ed25519_batch.B_NIELS  # (8, 3, 32): [1..8]B affine Niels
     return (
         np.ascontiguousarray(t[:, 0, :]),
         np.ascontiguousarray(t[:, 1, :]),
@@ -460,17 +557,38 @@ def _to_windows(raw: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([hi[::-1], lo[::-1]], axis=1).reshape(2 * NLIMBS, -1)
 
 
+def _to_windows_signed(raw: jnp.ndarray) -> jnp.ndarray:
+    """(N, 32) uint8 LE scalars -> (64, N) f32 signed digits in [-8, 8).
+
+    Same recoding as ed25519_batch._to_windows_signed: z = x + 0x88...88
+    (add 136 per byte, one exact f32 ripple-carry pass), split nibbles,
+    subtract 8. Exact for scalars < 2^253 — s is host-checked < L and
+    the challenge k is reduced mod L, both < 2^253.
+    """
+    b = raw.astype(jnp.float32).T  # (32, N)
+    carry = jnp.zeros_like(b[0])
+    z = []
+    for i in range(NLIMBS):  # 32-step ripple, unrolled at trace
+        t = b[i] + 136.0 + carry
+        carry = jnp.floor(t * INV_RADIX)
+        z.append(t - carry * RADIX)
+    zb = jnp.stack(z)  # (32, N), carry-out dropped (mod 2^256)
+    hi = jnp.floor(zb * (1.0 / 16.0))
+    lo = zb - 16.0 * hi
+    return jnp.stack([hi[::-1], lo[::-1]], axis=1).reshape(2 * NLIMBS, -1) - 8.0
+
+
 def verify_fn(pk_bytes, r_bytes, s_bytes, k_bytes, *, block: int, interpret: bool):
     """(N, 32) uint8 x4 -> (N,) bool. N must be a multiple of block."""
     n = pk_bytes.shape[0]
     a_y, a_sign = _strip_sign(pk_bytes.astype(jnp.float32).T)
     r_y, r_sign = _strip_sign(r_bytes.astype(jnp.float32).T)
-    s_win = _to_windows(s_bytes)
-    k_win = _to_windows(k_bytes)
+    s_win = _to_windows_signed(s_bytes)
+    k_win = _to_windows_signed(k_bytes)
     byp, bym, bt2 = _b_tables()
     grid = n // block
     lane_spec = lambda rows: pl.BlockSpec((rows, block), lambda i: (0, i))
-    const_spec = pl.BlockSpec((16, NLIMBS), lambda i: (0, 0))
+    const_spec = pl.BlockSpec((8, NLIMBS), lambda i: (0, 0))
     out = pl.pallas_call(
         _verify_kernel,
         grid=(grid,),
@@ -488,9 +606,47 @@ def verify_fn(pk_bytes, r_bytes, s_bytes, k_bytes, *, block: int, interpret: boo
         ],
         out_specs=lane_spec(1),
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((16, 4 * NLIMBS, block), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((8, 4 * NLIMBS, block), jnp.float32)],
         interpret=interpret,
     )(a_y, a_sign, r_y, r_sign, s_win, k_win, byp, bym, bt2, _CONSTS)
+    return out[0] != 0.0
+
+
+def verify_tables_fn(tab, a_ok, r_bytes, s_bytes, k_bytes, *, block: int, interpret: bool):
+    """Cache-hit path: (8, 4, 32, N) uint8 gathered tables + (N,) uint8
+    a_ok + (N, 32) uint8 r/s/k -> (N,) bool. N must be a multiple of
+    block. The (4, 32) component/limb axes collapse to the kernel's
+    128-row stacked-point layout (a free C-order reshape); the uint8 ->
+    f32 cast runs on device so the H2D transfer stays 4x smaller."""
+    n = r_bytes.shape[0]
+    tab_f = tab.astype(jnp.float32).reshape(8, 4 * NLIMBS, n)
+    aok = a_ok.astype(jnp.float32)[None, :]
+    r_y, r_sign = _strip_sign(r_bytes.astype(jnp.float32).T)
+    s_win = _to_windows_signed(s_bytes)
+    k_win = _to_windows_signed(k_bytes)
+    byp, bym, bt2 = _b_tables()
+    grid = n // block
+    lane_spec = lambda rows: pl.BlockSpec((rows, block), lambda i: (0, i))
+    const_spec = pl.BlockSpec((8, NLIMBS), lambda i: (0, 0))
+    out = pl.pallas_call(
+        _verify_tables_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((8, 4 * NLIMBS, block), lambda i: (0, 0, i)),
+            lane_spec(1),
+            lane_spec(32),
+            lane_spec(1),
+            lane_spec(64),
+            lane_spec(64),
+            const_spec,
+            const_spec,
+            const_spec,
+            pl.BlockSpec((NLIMBS, 3), lambda i: (0, 0)),
+        ],
+        out_specs=lane_spec(1),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
+        interpret=interpret,
+    )(tab_f, aok, r_y, r_sign, s_win, k_win, byp, bym, bt2, _CONSTS)
     return out[0] != 0.0
 
 
@@ -501,4 +657,16 @@ def compiled_verify(n: int, block: int = BLOCK, interpret: bool = False):
     assert n % blk == 0, (n, blk)
     return jax.jit(
         lambda pk, r, s, k: verify_fn(pk, r, s, k, block=blk, interpret=interpret)
+    )
+
+
+@lru_cache(maxsize=8)
+def compiled_verify_tables(n: int, block: int = BLOCK, interpret: bool = False):
+    """Jitted table-input verify for a fixed padded batch size n."""
+    blk = min(block, n)
+    assert n % blk == 0, (n, blk)
+    return jax.jit(
+        lambda tab, ok, r, s, k: verify_tables_fn(
+            tab, ok, r, s, k, block=blk, interpret=interpret
+        )
     )

@@ -1,0 +1,462 @@
+"""Validator-set-aware precompute and result caches for the verify hot path.
+
+Consensus, blocksync, and the light client verify signatures from the
+*same stable validator set* height after height.  The device kernel used
+to re-decompress every pubkey and rebuild every lane's signed-window
+cached-point table on every call; this module amortizes that work across
+a committee's lifetime:
+
+- :class:`PrecomputeCache` — a bounded, thread-safe LRU keyed by raw
+  pubkey bytes, holding the host-built signed-window table column
+  ``(8, 4, 32) uint8`` of ``[1..8](-A)`` in cached form ``(Y+X, Y-X, Z,
+  2dT)`` plus the decompression verdict.  ``verify_batch`` gathers the
+  cached columns into the ``(8, 4, 32, N)`` table input of the
+  table-taking kernel entry point (ops/ed25519_batch.py
+  ``verify_kernel_tables``), skipping pt_decompress-of-A and
+  ``_build_lane_table`` entirely for hit lanes.
+- :class:`ResultCache` — a bounded LRU over ``(pubkey, sign-bytes
+  digest, sig)`` verdicts, so blocksync/light/consensus never re-verify
+  the identical last-commit votes they verified one height ago.
+
+Eligibility is validator-set aware: in the default ``auto`` mode only
+keys that belong to an *activated* :class:`~tendermint_tpu.types.\
+validator_set.ValidatorSet` (or were explicitly pinned) get host-built
+tables, so one-off keys from ad-hoc batches cannot thrash the cache.
+Activating a new set invalidates entries for keys that left every
+active set (validator-set rotation).
+
+Env knobs::
+
+    TENDERMINT_TPU_PRECOMPUTE          auto (default) | all | off
+    TENDERMINT_TPU_PRECOMPUTE_CAP      max cached keys (default 16384)
+    TENDERMINT_TPU_RESULT_CACHE        1 (default) | 0
+    TENDERMINT_TPU_RESULT_CACHE_CAP    max cached verdicts (default 65536)
+
+This module imports neither jax nor field32 — table building runs on
+host big-ints (crypto/ed25519_ref) and the radix-2^8 f32 limb encoding
+is just the little-endian byte string — so the consensus layer can note
+validator sets without paying for an accelerator import.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+TABLE_WIDTH = 8  # signed 4-bit windows select from [1..8](-A)
+NLIMBS = 32
+
+_MODE_ENV = "TENDERMINT_TPU_PRECOMPUTE"
+_CAP_ENV = "TENDERMINT_TPU_PRECOMPUTE_CAP"
+_RESULT_ENV = "TENDERMINT_TPU_RESULT_CACHE"
+_RESULT_CAP_ENV = "TENDERMINT_TPU_RESULT_CACHE_CAP"
+
+_ACTIVE_SETS_CAP = 8  # distinct validator sets considered live at once
+
+
+def _mode() -> str:
+    return os.environ.get(_MODE_ENV, "auto").lower()
+
+
+def table_cache_enabled() -> bool:
+    return _mode() not in ("0", "off", "none", "false")
+
+
+def result_cache_enabled() -> bool:
+    return os.environ.get(_RESULT_ENV, "1").lower() not in (
+        "0", "off", "none", "false",
+    )
+
+
+def _limbs(v: int) -> np.ndarray:
+    """Canonical integer < 2^256 -> (32,) uint8 radix-2^8 limbs (LE)."""
+    return np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8)
+
+
+def _identity_table() -> np.ndarray:
+    """(8, 4, 32) table of cached-form identities (1, 1, 1, 0)."""
+    tab = np.zeros((TABLE_WIDTH, 4, NLIMBS), dtype=np.uint8)
+    tab[:, 0, 0] = 1
+    tab[:, 1, 0] = 1
+    tab[:, 2, 0] = 1
+    return tab
+
+
+def build_table(pk: bytes) -> Tuple[np.ndarray, bool]:
+    """Host-side builder: pubkey bytes -> ((8, 4, 32) uint8, decompress ok).
+
+    Entry ``i`` is ``(i+1) * (-A)`` in cached form with Z normalized to 1
+    — ``(y+x, y-x, 1, 2dxy)`` as canonical-integer limbs, which satisfies
+    the kernel's loose limb invariant by construction and packs into
+    uint8 (1 KiB per key; the kernel widens to f32 on device).  Invalid
+    encodings get identity entries and ``ok=False`` (the kernel masks
+    the lane).
+
+    Cost is one liberal decompression plus 7 chained big-int point adds
+    (~100 us), paid once per (validator, committee lifetime) instead of
+    15 wide device point-adds per lane per batch.
+    """
+    from tendermint_tpu.crypto import ed25519_ref as ref
+
+    p = ref.P
+    a_pt = ref.pt_decompress_liberal(pk) if len(pk) == 32 else None
+    if a_pt is None:
+        return _identity_table(), False
+    neg_a = ref.pt_neg(a_pt)
+    tab = np.zeros((TABLE_WIDTH, 4, NLIMBS), dtype=np.uint8)
+    acc = neg_a
+    for i in range(TABLE_WIDTH):
+        if i:
+            acc = ref.pt_add(acc, neg_a)
+        x_, y_, z_, _ = acc
+        zinv = pow(z_, p - 2, p)
+        x = x_ * zinv % p
+        y = y_ * zinv % p
+        tab[i, 0] = _limbs((y + x) % p)
+        tab[i, 1] = _limbs((y - x) % p)
+        tab[i, 2, 0] = 1
+        tab[i, 3] = _limbs(2 * ref.D * x * y % p)
+    return tab, True
+
+
+def _vset_ed25519_keys(vset) -> FrozenSet[bytes]:
+    """Raw 32-byte ed25519 pubkeys of a ValidatorSet (best effort)."""
+    keys = set()
+    for v in getattr(vset, "validators", ()):
+        pk = getattr(v, "pub_key", None)
+        if pk is None:
+            continue
+        try:
+            raw = pk.bytes()
+        except Exception:
+            continue
+        if isinstance(raw, (bytes, bytearray)) and len(raw) == 32:
+            if getattr(pk, "type", "ed25519") == "ed25519":
+                keys.add(bytes(raw))
+    return frozenset(keys)
+
+
+class PrecomputeCache:
+    """Bounded thread-safe LRU of per-validator signed-window tables."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[bytes, Tuple[np.ndarray, bool]]" = (
+            OrderedDict()
+        )
+        self._active_sets: "OrderedDict[bytes, FrozenSet[bytes]]" = (
+            OrderedDict()
+        )
+        self._eligible: FrozenSet[bytes] = frozenset()
+        self._pinned: set = set()
+        self._metrics = None
+        self.hits = 0
+        self.misses = 0
+        self.builds = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.build_seconds = 0.0
+
+    # --- configuration ------------------------------------------------------
+
+    @property
+    def cap(self) -> int:
+        try:
+            return max(1, int(os.environ.get(_CAP_ENV, "16384")))
+        except ValueError:
+            return 16384
+
+    def bind_metrics(self, metrics) -> None:
+        with self._lock:
+            self._metrics = metrics
+
+    # --- validator-set awareness -------------------------------------------
+
+    def activate_validator_set(self, vset) -> bool:
+        """Mark a validator set live: its keys become table-eligible.
+
+        Re-activating a known set is a cheap LRU touch.  Activating a
+        new one registers its key set, retires the oldest live set
+        beyond the bound, and drops cached tables for keys that no
+        longer belong to any live set (committee rotation).  Returns
+        True when the set was newly registered.
+        """
+        try:
+            vhash = vset.hash()
+        except Exception:
+            return False
+        with self._lock:
+            if vhash in self._active_sets:
+                self._active_sets.move_to_end(vhash)
+                return False
+            keys = _vset_ed25519_keys(vset)
+            self._active_sets[vhash] = keys
+            while len(self._active_sets) > _ACTIVE_SETS_CAP:
+                self._active_sets.popitem(last=False)
+            self._recompute_eligible_locked()
+            return True
+
+    def pin(self, pubkeys: Iterable[bytes]) -> None:
+        """Make specific keys table-eligible outside any validator set."""
+        with self._lock:
+            self._pinned.update(bytes(pk) for pk in pubkeys)
+            self._recompute_eligible_locked()
+
+    def _recompute_eligible_locked(self) -> None:
+        eligible = set(self._pinned)
+        for keys in self._active_sets.values():
+            eligible |= keys
+        self._eligible = frozenset(eligible)
+        if _mode() == "auto":
+            stale = [pk for pk in self._entries if pk not in self._eligible]
+            for pk in stale:
+                del self._entries[pk]
+            if stale:
+                self.invalidations += len(stale)
+                if self._metrics is not None:
+                    self._metrics.precompute_invalidations.inc(len(stale))
+
+    def _eligible_for_build(self, pk: bytes) -> bool:
+        mode = _mode()
+        if mode == "all":
+            return True
+        return pk in self._eligible
+
+    # --- cache body ---------------------------------------------------------
+
+    def _insert_locked(self, pk: bytes, table: np.ndarray, ok: bool) -> None:
+        self._entries[pk] = (table, ok)
+        self._entries.move_to_end(pk)
+        cap = self.cap
+        while len(self._entries) > cap:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+            if self._metrics is not None:
+                self._metrics.precompute_evictions.inc()
+
+    def lookup(self, pk: bytes) -> Optional[Tuple[np.ndarray, bool]]:
+        with self._lock:
+            entry = self._entries.get(pk)
+            if entry is not None:
+                self._entries.move_to_end(pk)
+            return entry
+
+    def gather(
+        self, pubkeys: Sequence[bytes]
+    ) -> Tuple[Optional[List[Tuple[np.ndarray, bool]]], np.ndarray]:
+        """Per-lane table lookup/build for a batch.
+
+        Returns ``(entries, has_table)`` where ``entries[i]`` is the
+        ``(table, ok)`` pair for lane i (None when the lane must take the
+        legacy build-on-device path) and ``has_table`` is the (N,) bool
+        partition mask.  Cache-hit lanes reuse the stored column;
+        eligible miss lanes are built on host (timed + counted) and
+        inserted; ineligible lanes stay on the legacy kernel so ad-hoc
+        batches cannot evict the live committee.
+        """
+        n = len(pubkeys)
+        has_table = np.zeros(n, dtype=bool)
+        if not table_cache_enabled():
+            return None, has_table
+        entries: List[Optional[Tuple[np.ndarray, bool]]] = [None] * n
+        with self._lock:
+            metrics = self._metrics
+            hits = misses = builds = 0
+            build_time = 0.0
+            seen: Dict[bytes, int] = {}
+            for i, pk in enumerate(pubkeys):
+                pk = bytes(pk)
+                entry = self._entries.get(pk)
+                if entry is not None:
+                    self._entries.move_to_end(pk)
+                    hits += 1
+                elif pk in seen:
+                    # duplicate signer inside one batch: one build serves
+                    # every lane, and only the first counts as a miss.
+                    entry = entries[seen[pk]]
+                    if entry is None:  # first occurrence was ineligible
+                        continue
+                elif self._eligible_for_build(pk):
+                    misses += 1
+                    t0 = time.perf_counter()
+                    table, ok = build_table(pk)
+                    build_time += time.perf_counter() - t0
+                    builds += 1
+                    entry = (table, ok)
+                    self._insert_locked(pk, table, ok)
+                else:
+                    misses += 1
+                    has_table[i] = False
+                    seen.setdefault(pk, i)
+                    continue
+                entries[i] = entry
+                has_table[i] = True
+                seen.setdefault(pk, i)
+            self.hits += hits
+            self.misses += misses
+            self.builds += builds
+            self.build_seconds += build_time
+        if metrics is not None:
+            if hits:
+                metrics.precompute_hits.inc(hits)
+            if misses:
+                metrics.precompute_misses.inc(misses)
+            if builds:
+                metrics.precompute_builds.inc(builds)
+                metrics.table_build_seconds.observe(build_time)
+        if not has_table.any():
+            return None, has_table
+        return entries, has_table
+
+    # --- introspection ------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "active_sets": len(self._active_sets),
+                "hits": self.hits,
+                "misses": self.misses,
+                "builds": self.builds,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "build_seconds": self.build_seconds,
+            }
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            self.hits = self.misses = self.builds = 0
+            self.evictions = self.invalidations = 0
+            self.build_seconds = 0.0
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._active_sets.clear()
+            self._pinned.clear()
+            self._eligible = frozenset()
+        self.reset_stats()
+
+
+class ResultCache:
+    """Bounded LRU of (pubkey, sign-bytes digest, sig) -> bool verdicts.
+
+    Verification is a pure function of the triple, so both verdicts are
+    cacheable; the digest keeps arbitrarily large sign-bytes out of the
+    key. Consulted before enqueueing lanes so a vote verified at height
+    H never costs device time again at H+1 (last-commit re-verification)
+    or when flooded in from N peers.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, bool]" = OrderedDict()
+        self._metrics = None
+        self.hits = 0
+        self.misses = 0
+
+    @property
+    def cap(self) -> int:
+        try:
+            return max(1, int(os.environ.get(_RESULT_CAP_ENV, "65536")))
+        except ValueError:
+            return 65536
+
+    def bind_metrics(self, metrics) -> None:
+        with self._lock:
+            self._metrics = metrics
+
+    @staticmethod
+    def _key(pk: bytes, msg: bytes, sig: bytes) -> bytes:
+        return b"".join((pk, hashlib.sha256(msg).digest(), sig))
+
+    def get(self, pk: bytes, msg: bytes, sig: bytes) -> Optional[bool]:
+        if not result_cache_enabled():
+            return None
+        key = self._key(pk, msg, sig)
+        with self._lock:
+            metrics = self._metrics
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                hit = True
+                verdict = self._entries[key]
+            else:
+                self.misses += 1
+                hit = False
+                verdict = None
+        if metrics is not None:
+            (metrics.result_cache_hits if hit else
+             metrics.result_cache_misses).inc()
+        return verdict
+
+    def put(self, pk: bytes, msg: bytes, sig: bytes, verdict: bool) -> None:
+        if not result_cache_enabled():
+            return
+        key = self._key(pk, msg, sig)
+        with self._lock:
+            self._entries[key] = bool(verdict)
+            self._entries.move_to_end(key)
+            cap = self.cap
+            while len(self._entries) > cap:
+                self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            self.hits = self.misses = 0
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+        self.reset_stats()
+
+
+# --- process-wide singletons -------------------------------------------------
+
+tables = PrecomputeCache()
+results = ResultCache()
+
+
+def activate_validator_set(vset) -> bool:
+    return tables.activate_validator_set(vset)
+
+
+def pin_pubkeys(pubkeys: Iterable[bytes]) -> None:
+    tables.pin(pubkeys)
+
+
+def bind_metrics(metrics) -> None:
+    tables.bind_metrics(metrics)
+    results.bind_metrics(metrics)
+
+
+def stats() -> Dict[str, Dict[str, float]]:
+    return {"precompute": tables.stats(), "result_cache": results.stats()}
+
+
+def reset() -> None:
+    """Drop all cached state and counters (tests, bench isolation)."""
+    tables.clear()
+    results.clear()

@@ -37,7 +37,7 @@ from tendermint_tpu.ops.ed25519_batch import (
     CHUNK,
     _bucket,
     _bytes_to_fe,
-    _to_windows,
+    _to_windows_signed,
     canonical_lt,
     straus_sb_minus_ka,
 )
@@ -132,8 +132,11 @@ def verify_kernel_sr(
     r_pt = tuple(c[:, nn:] for c in both_pt)
     a_ok, r_ok = both_ok[:nn], both_ok[nn:]
 
-    s_win = _to_windows(s_bytes)
-    k_win = _to_windows(k_bytes)
+    # Signed 4-bit windows, shared with ed25519: both s (masked to 255
+    # bits and checked < L on host) and the Merlin challenge k (< L)
+    # are < 2^253, so the signed recode is exact.
+    s_win = _to_windows_signed(s_bytes)
+    k_win = _to_windows_signed(k_bytes)
     acc = straus_sb_minus_ka(a_pt, s_win, k_win)
     acc = curve.pt_add(acc, curve.pt_neg(r_pt))
     # ristretto identity coset: X == 0 or Y == 0 (RFC 9496 equality
@@ -164,9 +167,11 @@ def verify_batch_sr(
     backend: Optional[str] = None,
 ) -> List[bool]:
     """Per-entry schnorrkel batch verification on the device, host
-    Merlin challenges. Large batches dispatch in CHUNK-size launches
-    (one compiled kernel, H2D of chunk j+1 overlapping compute of
-    chunk j); device failure degrades per CHUNK to the host oracle
+    Merlin challenges. Chunk dispatch is double-buffered: the Merlin
+    transcript challenges of chunk j+1 — the expensive, sequential
+    host work on this path — are computed while the device crunches
+    chunk j (JAX async dispatch), instead of hashing the whole batch
+    up front. Device failure degrades per CHUNK to the host oracle
     under the process-wide health state machine shared with ed25519
     (ops/device_policy.py), which cools down, probes, and re-promotes
     the device path by itself."""
@@ -190,8 +195,7 @@ def verify_batch_sr(
     pk_arr = np.zeros((n, 32), dtype=np.uint8)
     r_arr = np.zeros((n, 32), dtype=np.uint8)
     s_arr = np.zeros((n, 32), dtype=np.uint8)
-    k_arr = np.zeros((n, 32), dtype=np.uint8)
-    for i, (pub, msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
+    for i, (pub, _msg, sig) in enumerate(zip(pubkeys, msgs, sigs)):
         if len(pub) != 32 or len(sig) != 64 or not sig[63] & 0x80:
             host_ok[i] = False
             continue
@@ -200,8 +204,7 @@ def verify_batch_sr(
         s_raw = bytearray(sig[32:64])
         s_raw[31] &= 0x7F
         s_arr[i] = np.frombuffer(bytes(s_raw), dtype=np.uint8)
-        k = _challenge(_signing_transcript(msg), pub, sig[:32])
-        k_arr[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
+    has_fields = host_ok.copy()  # lanes whose challenge is worth hashing
     # scalar canonicity: s < L; encodings canonical (< p) and
     # non-negative (even) for both A and R
     host_ok &= canonical_lt(s_arr, _l_bytes_be())
@@ -211,13 +214,7 @@ def verify_batch_sr(
 
     try:
         m = _bucket(n)
-        if m > n:
-            # pad with a known-good lane (a fixed self-consistent triple)
-            pad_pk, pad_r, pad_s, pad_k = _pad_entry()
-            pk_arr = np.concatenate([pk_arr, np.tile(pad_pk, (m - n, 1))])
-            r_arr = np.concatenate([r_arr, np.tile(pad_r, (m - n, 1))])
-            s_arr = np.concatenate([s_arr, np.tile(pad_s, (m - n, 1))])
-            k_arr = np.concatenate([k_arr, np.tile(pad_k, (m - n, 1))])
+        pad = _pad_entry() if m > n else None
         from tendermint_tpu.ops.ed25519_batch import active_impl
 
         impl = active_impl(backend)
@@ -234,40 +231,84 @@ def verify_batch_sr(
         health.count_fallback("sr25519", n)
         return [verify_host(p, m, s) for p, m, s in zip(pubkeys, msgs, sigs)]
 
-    # Dispatch phase: enqueue chunk kernels back-to-back (H2D of chunk
-    # j+1 overlaps compute of chunk j). A failing chunk falls back to
-    # the host oracle for ITS lanes only; the health machine decides
-    # whether the remaining chunks may still use the device.
-    chunks = []  # (lo, hi, device result or None)
-    for lo in range(0, m, CHUNK):
-        hi = min(lo + CHUNK, m)
-        if attempt is None:
-            attempt = health.begin_attempt("sr25519")
-        if attempt is None:
-            chunks.append((lo, hi, None))
-            continue
-        try:
-            fault_injection.fire("sr25519.chunk")
-            chunks.append(
-                (
-                    lo,
-                    hi,
-                    _compiled_kernel_sr(hi - lo, backend, mul_impl)(
-                        jnp.asarray(pk_arr[lo:hi]), jnp.asarray(r_arr[lo:hi]),
-                        jnp.asarray(s_arr[lo:hi]), jnp.asarray(k_arr[lo:hi]),
-                    ),
+    def prep_chunk(lo: int, hi: int):
+        """Merlin challenges + padding for lanes [lo, hi) — the host
+        half of the double buffer."""
+        top = min(hi, n)
+        k_c = np.zeros((hi - lo, 32), dtype=np.uint8)
+        for i in range(lo, top):
+            if has_fields[i]:
+                k = _challenge(_signing_transcript(msgs[i]), pubkeys[i], sigs[i][:32])
+                k_c[i - lo] = np.frombuffer(
+                    k.to_bytes(32, "little"), dtype=np.uint8
                 )
-            )
-        except Exception as exc:
-            health.record_failure(exc, attempt)
-            attempt = None
-            import warnings
+        if hi > top:
+            pad_pk, pad_r, pad_s, pad_k = pad
+            npad = hi - top
+            pk_c = np.concatenate([pk_arr[lo:top], np.tile(pad_pk, (npad, 1))])
+            r_c = np.concatenate([r_arr[lo:top], np.tile(pad_r, (npad, 1))])
+            s_c = np.concatenate([s_arr[lo:top], np.tile(pad_s, (npad, 1))])
+            k_c[top - lo :] = pad_k
+        else:
+            pk_c, r_c, s_c = pk_arr[lo:hi], r_arr[lo:hi], s_arr[lo:hi]
+        return pk_c, r_c, s_c, k_c
 
-            warnings.warn(
-                f"sr25519 device chunk [{lo}:{hi}] dispatch failed ({exc!r}); "
-                f"CPU fallback for the chunk (device state={health.state})"
-            )
-            chunks.append((lo, hi, None))
+    # Double-buffered dispatch: enqueue chunk j's kernel (async), then
+    # hash chunk j+1's challenges while the device crunches chunk j. A
+    # failing chunk falls back to the host oracle for ITS lanes only;
+    # the health machine decides whether the remaining chunks may still
+    # use the device.
+    bounds = [(lo, min(lo + CHUNK, m)) for lo in range(0, m, CHUNK)]
+    preps: List[Optional[tuple]] = [None] * len(bounds)
+    chunks = []  # (lo, hi, device result or None)
+    for ci, (lo, hi) in enumerate(bounds):
+        if ci == 0:
+            try:
+                preps[0] = prep_chunk(lo, hi)
+            except Exception as exc:
+                health.record_failure(exc, attempt)
+                attempt = None
+                import warnings
+
+                warnings.warn(
+                    f"sr25519 chunk [{lo}:{hi}] prepare failed ({exc!r}); "
+                    f"CPU fallback for the chunk (device state={health.state})"
+                )
+        out = None
+        if preps[ci] is not None:
+            if attempt is None:
+                attempt = health.begin_attempt("sr25519")
+            if attempt is not None:
+                try:
+                    fault_injection.fire("sr25519.chunk")
+                    out = _compiled_kernel_sr(hi - lo, backend, mul_impl)(
+                        *(jnp.asarray(a) for a in preps[ci])
+                    )
+                except Exception as exc:
+                    health.record_failure(exc, attempt)
+                    attempt = None
+                    import warnings
+
+                    warnings.warn(
+                        f"sr25519 device chunk [{lo}:{hi}] dispatch failed "
+                        f"({exc!r}); CPU fallback for the chunk "
+                        f"(device state={health.state})"
+                    )
+        preps[ci] = None  # free the buffers once dispatched
+        chunks.append((lo, hi, out))
+        if ci + 1 < len(bounds):
+            nlo, nhi = bounds[ci + 1]
+            try:
+                preps[ci + 1] = prep_chunk(nlo, nhi)
+            except Exception as exc:
+                health.record_failure(exc, attempt)
+                attempt = None
+                import warnings
+
+                warnings.warn(
+                    f"sr25519 chunk [{nlo}:{nhi}] prepare failed ({exc!r}); "
+                    f"CPU fallback for the chunk (device state={health.state})"
+                )
 
     # Collect phase: async dispatch surfaces runtime errors here too.
     results = np.ones(m, dtype=bool)

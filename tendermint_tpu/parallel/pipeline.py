@@ -18,6 +18,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
+from tendermint_tpu.crypto import batch as crypto_batch
 from tendermint_tpu.types.block import BLOCK_ID_FLAG_COMMIT, BlockID, Commit
 from tendermint_tpu.types.validation import (
     InvalidCommitError,
@@ -69,6 +70,9 @@ def verify_commits_pipelined(
         except InvalidCommitError as e:
             verdicts[t_i] = CommitVerdict(False, e)
             continue
+        # Eligibility for the device precompute cache; a blocksync
+        # window reuses one validator set across most of its blocks.
+        crypto_batch.note_validator_set(task.vals)
         needed = task.vals.total_voting_power() * 2 // 3
         start = len(flat_pks)
         sig_idxs: List[int] = []

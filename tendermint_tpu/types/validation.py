@@ -144,6 +144,9 @@ def _verify_commit_batch(
     tallied = 0
     seen_vals = {}
     batch_sig_idxs = []
+    # Make this set's keys eligible for the device precompute cache —
+    # the second commit from the same validators skips its table builds.
+    crypto_batch.note_validator_set(vals)
     # Mixed validator sets sub-batch per key type (BASELINE config 5);
     # an unsupported key (secp256k1) raises on add -> single fallback.
     bv = crypto_batch.MultiBatchVerifier()

@@ -19,3 +19,22 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_verify_caches(monkeypatch):
+    """Pin the verify caches to a known state per test.
+
+    The result cache defaults ON in production; under pytest the suite
+    reuses identical (pk, msg, sig) triples across tests, so a default-on
+    cache would short-circuit device paths other tests assert on
+    (fallback counters, kernel dispatch warnings). Tests that exercise
+    the caches opt back in with monkeypatch (tests/test_precompute.py).
+    """
+    from tendermint_tpu.ops import precompute
+
+    monkeypatch.setenv(precompute._RESULT_ENV, "0")
+    precompute.reset()
+    yield

@@ -63,6 +63,32 @@ def test_windows_unpack():
     assert recon == s
 
 
+def test_windows_signed_unpack():
+    from tendermint_tpu.ops.ed25519_batch import _to_windows_signed
+
+    rng = np.random.default_rng(3)
+    vals = [
+        0,
+        1,
+        ref.L - 1,
+        2**253 - 1,
+        int.from_bytes(rng.integers(0, 256, 31, dtype=np.uint8).tobytes(), "little"),
+    ]
+    raw = jnp.asarray(
+        np.stack(
+            [np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8) for v in vals]
+        )
+    )
+    win = np.asarray(_to_windows_signed(raw))  # (64, n) MSB-first signed digits
+    for j, v in enumerate(vals):
+        recon = 0
+        for i in range(64):
+            d = int(win[i, j])
+            assert -8 <= d <= 7
+            recon = recon * 16 + d
+        assert recon == v
+
+
 def test_s_canonical_boundary():
     L = ref.L
     vals = [0, 1, L - 1, L, L + 1, 2**256 - 1]

@@ -106,6 +106,39 @@ def test_pallas_off_curve_and_mutations(batch8):
     assert got == want
 
 
+def pallas_verify_batch_tables(pks, msgs, sigs):
+    """Table-input kernel: host-built precompute columns, no in-kernel
+    table construction. Mirrors _run_chunk_tables' pallas branch."""
+    from tendermint_tpu.ops import precompute
+
+    n = len(pks)
+    pad = ((n + 7) // 8) * 8
+    tabs, oks = zip(*(precompute.build_table(pk) for pk in pks))
+    inputs, host_ok = ed25519_batch._prep_table_chunk(
+        pks, msgs, sigs, list(tabs), list(oks), pad_to=pad
+    )
+    fn = pallas_verify.compiled_verify_tables(pad, block=8, interpret=True)
+    out = fn(
+        jnp.asarray(inputs["tab"]),
+        jnp.asarray(inputs["ok"]),
+        jnp.asarray(inputs["r"]),
+        jnp.asarray(inputs["s"]),
+        jnp.asarray(inputs["k"]),
+    )
+    return list(np.logical_and(np.asarray(out)[:n], host_ok))
+
+
+@pytest.mark.slow  # interpret-mode XLA compile of this kernel runs ~8 min
+def test_pallas_table_path_parity(batch8):
+    pks, msgs, sigs = (list(x) for x in batch8)
+    pks[0] = bytes([2] + [0] * 31)  # off-curve: identity table, ok=False
+    sigs[1] = sigs[1][:33] + bytes([sigs[1][33] ^ 1]) + sigs[1][34:]
+    msgs[2] = b"tampered"
+    pks[3] = (ref.P + 1).to_bytes(32, "little")  # non-canonical encoding
+    want = [ref.verify_zip215(pk, m, s) for pk, m, s in zip(pks, msgs, sigs)]
+    assert pallas_verify_batch_tables(pks, msgs, sigs) == want
+
+
 def test_dispatch_prefers_pallas_on_tpu(monkeypatch):
     """active_impl routes TPU platforms to the Pallas kernel, CPU to XLA."""
     monkeypatch.delenv(ed25519_batch._IMPL_ENV, raising=False)

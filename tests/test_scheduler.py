@@ -83,9 +83,40 @@ class TestBatching:
             for t in threads:
                 t.join(timeout=15)
             assert all(results)
-            # 40 concurrent verifies amortized into far fewer flushes
+            # 40 concurrent verifies amortized into far fewer flushes;
+            # only the 8 unique (pk, msg, sig) triples cost verifier
+            # lanes — duplicates within a flush coalesce.
             assert len(calls) < 10, calls
-            assert sum(calls) == 40
+            assert 8 <= sum(calls) <= 40
+            assert s.entries_verified == 40
+            assert sum(calls) + s.entries_coalesced == 40
+        finally:
+            s.stop()
+
+    def test_duplicate_submissions_coalesce_to_one_lane(self):
+        calls = []
+
+        def counting_verify(pks, msgs, sigs):
+            calls.append(len(pks))
+            return host_verify(pks, msgs, sigs)
+
+        s = VerifyScheduler(counting_verify, max_batch=64, max_delay=60.0)
+        s.start()
+        try:
+            good = _signed(1)
+            bad = (good[0], good[1], bytes(64))
+            handles = [s.submit(*good) for _ in range(5)]
+            handles += [s.submit(*bad) for _ in range(3)]
+            # force the flush now rather than waiting out the deadline
+            with s._wake:
+                s.max_delay = 0.0
+                s._wake.notify_all()
+            oks = [s.wait(h) for h in handles]
+            assert oks == [True] * 5 + [False] * 3
+            # 8 submissions, 2 unique triples, 1 flush
+            assert calls == [2], calls
+            assert s.entries_coalesced == 6
+            assert s.entries_verified == 8
         finally:
             s.stop()
 
