@@ -358,8 +358,14 @@ def child_main() -> None:
     # Explicit operator env still wins; _cache_amortization re-enables
     # it locally to report the cache numbers.
     os.environ.setdefault("TENDERMINT_TPU_RESULT_CACHE", "0")
+    # Span tracing in ring mode: trace_summary below comes from the spans
+    # the verify pipeline actually emitted. Explicit operator env wins.
+    os.environ.setdefault("TENDERMINT_TPU_TRACE", "ring")
 
+    from tendermint_tpu.libs import tracing
     from tendermint_tpu.ops import ed25519_batch
+
+    tracing.configure()
 
     backend = jax.default_backend()
     rng = np.random.default_rng(1234)
@@ -370,11 +376,13 @@ def child_main() -> None:
     assert all(oks), "benchmark signatures must verify"
 
     best = 0.0
+    tracing.tracer.clear()  # summarize the measured rounds, not warmup
     for _ in range(ROUNDS):
         t0 = time.perf_counter()
         ed25519_batch.verify_batch(pks, msgs, sigs)
         dt = time.perf_counter() - t0
         best = max(best, BATCH / dt)
+    trace_summary = tracing.tracer.summary() or None
 
     stages = _stage_breakdown(pks, msgs, sigs)
     commit_p50 = None
@@ -397,6 +405,7 @@ def child_main() -> None:
                 "backend": backend,
                 "impl": stages.pop("impl"),
                 "stages_ms": stages,
+                "trace_summary": trace_summary,
                 f"verify_commit_p50_ms_v{COMMIT_VALS}": commit_p50,
                 f"light_client_headers_per_s_v{LIGHT_VALS}": light_hps,
                 f"blocksync_blocks_per_s_v{SYNC_VALS}": sync_bps,

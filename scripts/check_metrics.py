@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Static audit: every instrument declared in libs/metrics.py is used.
+
+Walks the metrics-class declarations (``self.X = reg.counter|gauge|
+histogram(...)``) with the ast module, then greps the package source for
+``.X`` attribute references outside the declaration site. A declared-but-
+never-referenced instrument is dead weight on every /metrics scrape and
+usually means an instrumentation seam silently fell off in a refactor —
+this script makes that a CI failure instead of a dashboard mystery.
+
+Usage: python scripts/check_metrics.py  (exit 0 clean, 1 on dead
+instruments; also asserted by tests/test_metrics.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "tendermint_tpu")
+METRICS_PY = os.path.join(PACKAGE, "libs", "metrics.py")
+
+_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def declared_instruments(path: str = METRICS_PY) -> dict:
+    """Map attribute name -> (class, lineno) for every ``self.X =
+    reg.counter|gauge|histogram(...)`` assignment."""
+    with open(path, "r") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    out = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                continue
+            call = node.value
+            if not (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in _FACTORIES
+            ):
+                continue
+            out[tgt.attr] = (cls.name, node.lineno)
+    return out
+
+
+def referenced_attrs(root: str = PACKAGE, skip: str = METRICS_PY) -> set:
+    """Attribute names referenced as ``.X`` anywhere under ``root``
+    except the declaration file itself."""
+    refs = set()
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if os.path.abspath(path) == os.path.abspath(skip):
+                continue
+            with open(path, "r") as fh:
+                try:
+                    tree = ast.parse(fh.read(), filename=path)
+                except SyntaxError:
+                    continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Attribute):
+                    refs.add(node.attr)
+    return refs
+
+
+def find_dead_instruments() -> list:
+    decls = declared_instruments()
+    refs = referenced_attrs()
+    return sorted(
+        (name, cls, lineno)
+        for name, (cls, lineno) in decls.items()
+        if name not in refs
+    )
+
+
+def main() -> int:
+    decls = declared_instruments()
+    dead = find_dead_instruments()
+    if dead:
+        for name, cls, lineno in dead:
+            print(
+                f"DEAD INSTRUMENT {cls}.{name} "
+                f"(libs/metrics.py:{lineno}): declared but never "
+                f"referenced under tendermint_tpu/",
+                file=sys.stderr,
+            )
+        return 1
+    print(f"ok: all {len(decls)} declared instruments are referenced")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

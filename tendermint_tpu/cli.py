@@ -178,6 +178,8 @@ def _run_seed(cfg: Config) -> int:
 def cmd_start(args) -> int:
     """commands/run_node.go: assemble and run until SIGINT/SIGTERM."""
     cfg = _load_cfg(args)
+    if getattr(args, "trace", ""):
+        cfg.base.trace = args.trace
     if cfg.base.mode not in ("full", "seed"):
         print(
             f"error: [base] mode must be 'full' or 'seed', "
@@ -868,6 +870,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_init)
 
     p = sub.add_parser("start", help="run the node")
+    p.add_argument(
+        "--trace",
+        default="",
+        help="span tracing: off | ring (serve at /debug/traces) | "
+        "<path> (write Chrome-trace JSON at exit); overrides config/env",
+    )
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("testnet", help="generate localhost testnet homes")

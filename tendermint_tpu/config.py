@@ -53,6 +53,11 @@ class BaseConfig:
     # Snapshot cadence of the BUILT-IN kvstore apps (state-sync
     # providers); out-of-process apps configure their own.
     app_snapshot_interval: int = 0
+    # Verify-pipeline span tracing (libs/tracing): "" inherits the
+    # TENDERMINT_TPU_TRACE env var (default off), "ring" keeps a bounded
+    # in-memory ring served at GET /debug/traces, any other value is a
+    # Chrome-trace JSON path flushed at process exit.
+    trace: str = ""
 
 
 @dataclass
@@ -169,6 +174,7 @@ class Config:
             p2p_recv_rate=self.p2p.recv_rate,
             p2p_queue_type=self.p2p.queue_type,
             double_sign_check_height=self.consensus.double_sign_check_height,
+            trace=self.base.trace,
         )
 
     # --- TOML ---------------------------------------------------------------

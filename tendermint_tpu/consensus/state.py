@@ -35,6 +35,7 @@ from tendermint_tpu.encoding.canonical import (
     SIGNED_MSG_TYPE_PREVOTE,
     Timestamp,
 )
+from tendermint_tpu.libs import tracing
 from tendermint_tpu.privval.base import PrivValidator
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.state.state import State as SMState
@@ -434,7 +435,10 @@ class ConsensusState:
         self.metrics.height.set(height)
         self.metrics.rounds.set(round_)
         self.metrics.validators.set(len(validators.validators))
-        self.logger.debug("entering new round", height=height, round=round_)
+        self.logger.with_fields(height=height, round=round_).debug(
+            "entering new round"
+        )
+        tracing.instant("new_round", height=height, round=round_)
         self._publish_event(
             "publish_event_new_round",
             lambda eb: eb.EventDataNewRound(
@@ -455,27 +459,30 @@ class ConsensusState:
             or (rs.round == round_ and rs.step >= RoundStep.PROPOSE)
         ):
             return
-        try:
-            # Schedule prevote-on-timeout before doing anything slow.
-            self.ticker.schedule_timeout(
-                self.state.consensus_params.timeout.propose_timeout(round_),
-                height,
-                round_,
-                RoundStep.PROPOSE,
-            )
-            if self.priv_validator is None or self.priv_pub_key is None:
-                return
-            addr = self.priv_pub_key.address()
-            if not rs.validators.has_address(addr):
-                return
-            if self._is_proposer(addr):
-                self.decide_proposal(height, round_)
-        finally:
-            rs.round = round_
-            rs.step = RoundStep.PROPOSE
-            self._new_step()
-            if self._is_proposal_complete():
-                self._enter_prevote(height, rs.round)
+        log = self.logger.with_fields(height=height, round=round_)
+        log.debug("entering propose step")
+        with tracing.span("propose", step="propose", height=height, round=round_):
+            try:
+                # Schedule prevote-on-timeout before doing anything slow.
+                self.ticker.schedule_timeout(
+                    self.state.consensus_params.timeout.propose_timeout(round_),
+                    height,
+                    round_,
+                    RoundStep.PROPOSE,
+                )
+                if self.priv_validator is None or self.priv_pub_key is None:
+                    return
+                addr = self.priv_pub_key.address()
+                if not rs.validators.has_address(addr):
+                    return
+                if self._is_proposer(addr):
+                    self.decide_proposal(height, round_)
+            finally:
+                rs.round = round_
+                rs.step = RoundStep.PROPOSE
+                self._new_step()
+                if self._is_proposal_complete():
+                    self._enter_prevote(height, rs.round)
 
     def _is_proposer(self, address: bytes) -> bool:
         return self.rs.validators.get_proposer().address == address
@@ -548,10 +555,14 @@ class ConsensusState:
             or (rs.round == round_ and rs.step >= RoundStep.PREVOTE)
         ):
             return
-        self._do_prevote(height, round_)
-        rs.round = round_
-        rs.step = RoundStep.PREVOTE
-        self._new_step()
+        self.logger.with_fields(height=height, round=round_).debug(
+            "entering prevote step"
+        )
+        with tracing.span("prevote", step="prevote", height=height, round=round_):
+            self._do_prevote(height, round_)
+            rs.round = round_
+            rs.step = RoundStep.PREVOTE
+            self._new_step()
 
     def _proposal_is_timely(self) -> bool:
         rs = self.rs
@@ -646,52 +657,56 @@ class ConsensusState:
             or (rs.round == round_ and rs.step >= RoundStep.PRECOMMIT)
         ):
             return
-        try:
-            prevotes = rs.votes.prevotes(round_)
-            block_id, ok = (
-                prevotes.two_thirds_majority() if prevotes else (BlockID(), False)
-            )
-            if not ok:
-                self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
-                return
-            if block_id.is_nil():
-                self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
-                return
-            if rs.proposal is None or rs.proposal_block is None:
-                self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
-                return
-            if rs.proposal.timestamp != rs.proposal_block.header.time:
-                self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
-                return
-            if (
-                rs.locked_block is not None
-                and rs.locked_block.hash() == block_id.hash
-            ):
-                rs.locked_round = round_
-                self._sign_add_vote(
-                    SIGNED_MSG_TYPE_PRECOMMIT, block_id.hash, block_id.part_set_header
+        self.logger.with_fields(height=height, round=round_).debug(
+            "entering precommit step"
+        )
+        with tracing.span("precommit", step="precommit", height=height, round=round_):
+            try:
+                prevotes = rs.votes.prevotes(round_)
+                block_id, ok = (
+                    prevotes.two_thirds_majority() if prevotes else (BlockID(), False)
                 )
-                return
-            if rs.proposal_block.hash() == block_id.hash:
-                self.block_exec.validate_block(self.state, rs.proposal_block)
-                rs.locked_round = round_
-                rs.locked_block = rs.proposal_block
-                rs.locked_block_parts = rs.proposal_block_parts
-                self._sign_add_vote(
-                    SIGNED_MSG_TYPE_PRECOMMIT, block_id.hash, block_id.part_set_header
-                )
-                return
-            # Polka for a block we don't have: fetch it, precommit nil.
-            if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
-                block_id.part_set_header
-            ):
-                rs.proposal_block = None
-                rs.proposal_block_parts = PartSet(block_id.part_set_header)
-            self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
-        finally:
-            rs.round = round_
-            rs.step = RoundStep.PRECOMMIT
-            self._new_step()
+                if not ok:
+                    self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
+                    return
+                if block_id.is_nil():
+                    self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
+                    return
+                if rs.proposal is None or rs.proposal_block is None:
+                    self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
+                    return
+                if rs.proposal.timestamp != rs.proposal_block.header.time:
+                    self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
+                    return
+                if (
+                    rs.locked_block is not None
+                    and rs.locked_block.hash() == block_id.hash
+                ):
+                    rs.locked_round = round_
+                    self._sign_add_vote(
+                        SIGNED_MSG_TYPE_PRECOMMIT, block_id.hash, block_id.part_set_header
+                    )
+                    return
+                if rs.proposal_block.hash() == block_id.hash:
+                    self.block_exec.validate_block(self.state, rs.proposal_block)
+                    rs.locked_round = round_
+                    rs.locked_block = rs.proposal_block
+                    rs.locked_block_parts = rs.proposal_block_parts
+                    self._sign_add_vote(
+                        SIGNED_MSG_TYPE_PRECOMMIT, block_id.hash, block_id.part_set_header
+                    )
+                    return
+                # Polka for a block we don't have: fetch it, precommit nil.
+                if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                    block_id.part_set_header
+                ):
+                    rs.proposal_block = None
+                    rs.proposal_block_parts = PartSet(block_id.part_set_header)
+                self._sign_add_vote(SIGNED_MSG_TYPE_PRECOMMIT, b"", PartSetHeader())
+            finally:
+                rs.round = round_
+                rs.step = RoundStep.PRECOMMIT
+                self._new_step()
 
     def _enter_precommit_wait(self, height: int, round_: int) -> None:
         rs = self.rs
@@ -713,30 +728,34 @@ class ConsensusState:
         rs = self.rs
         if rs.height != height or rs.step >= RoundStep.COMMIT:
             return
-        try:
-            precommits = rs.votes.precommits(commit_round)
-            block_id, ok = precommits.two_thirds_majority()
-            if not ok:
-                raise RuntimeError("enterCommit expects +2/3 precommits")
-            if (
-                rs.locked_block is not None
-                and rs.locked_block.hash() == block_id.hash
-            ):
-                rs.proposal_block = rs.locked_block
-                rs.proposal_block_parts = rs.locked_block_parts
-            if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+        self.logger.with_fields(height=height, round=commit_round).debug(
+            "entering commit step"
+        )
+        with tracing.span("commit", step="commit", height=height, round=commit_round):
+            try:
+                precommits = rs.votes.precommits(commit_round)
+                block_id, ok = precommits.two_thirds_majority()
+                if not ok:
+                    raise RuntimeError("enterCommit expects +2/3 precommits")
                 if (
-                    rs.proposal_block_parts is None
-                    or not rs.proposal_block_parts.has_header(block_id.part_set_header)
+                    rs.locked_block is not None
+                    and rs.locked_block.hash() == block_id.hash
                 ):
-                    rs.proposal_block = None
-                    rs.proposal_block_parts = PartSet(block_id.part_set_header)
-        finally:
-            rs.step = RoundStep.COMMIT
-            rs.commit_round = commit_round
-            rs.commit_time = self._now()
-            self._new_step()
-            self._try_finalize_commit(height)
+                    rs.proposal_block = rs.locked_block
+                    rs.proposal_block_parts = rs.locked_block_parts
+                if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+                    if (
+                        rs.proposal_block_parts is None
+                        or not rs.proposal_block_parts.has_header(block_id.part_set_header)
+                    ):
+                        rs.proposal_block = None
+                        rs.proposal_block_parts = PartSet(block_id.part_set_header)
+            finally:
+                rs.step = RoundStep.COMMIT
+                rs.commit_round = commit_round
+                rs.commit_time = self._now()
+                self._new_step()
+                self._try_finalize_commit(height)
 
     def _try_finalize_commit(self, height: int) -> None:
         rs = self.rs
@@ -799,9 +818,8 @@ class ConsensusState:
             1 for cs in block.last_commit.signatures if cs.is_absent()
         ) if block.last_commit else 0
         self.metrics.missing_validators.set(n_absent)
-        self.logger.info(
+        self.logger.with_fields(height=height, round=rs.commit_round).info(
             "committed block",
-            height=height,
             hash=block.hash(),
             txs=len(block.data.txs),
         )

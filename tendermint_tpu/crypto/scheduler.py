@@ -30,6 +30,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from tendermint_tpu.libs import tracing
+
 DEFAULT_MAX_BATCH = 256
 DEFAULT_MAX_DELAY = 0.002  # 2ms: well under a vote round-trip
 
@@ -172,29 +174,33 @@ class VerifyScheduler:
             sigs: List[bytes] = []
             index: dict = {}
             slots: List[int] = []
-            for p in batch:
-                key = (p.pubkey, p.msg, p.sig)
-                idx = index.get(key)
-                if idx is None:
-                    idx = index[key] = len(pks)
-                    pks.append(p.pubkey)
-                    msgs.append(p.msg)
-                    sigs.append(p.sig)
-                slots.append(idx)
+            with tracing.span("sched_assemble", lanes=len(batch)) as asp:
+                for p in batch:
+                    key = (p.pubkey, p.msg, p.sig)
+                    idx = index.get(key)
+                    if idx is None:
+                        idx = index[key] = len(pks)
+                        pks.append(p.pubkey)
+                        msgs.append(p.msg)
+                        sigs.append(p.sig)
+                    slots.append(idx)
+                asp.set(unique=len(pks), coalesced=len(batch) - len(pks))
             self.entries_coalesced += len(batch) - len(pks)
-            try:
-                oks = self._verify_fn(pks, msgs, sigs)
-            except Exception:
-                self.flush_errors += 1
-                oks = None
-                if self._fallback_fn is not None:
-                    try:
-                        oks = self._fallback_fn(pks, msgs, sigs)
-                        self.fallback_flushes += 1
-                    except Exception:
-                        oks = None
-                if oks is None:
-                    oks = [False] * len(pks)  # fail closed, never hang callers
+            with tracing.span("sched_flush", lanes=len(pks)):
+                try:
+                    oks = self._verify_fn(pks, msgs, sigs)
+                except Exception:
+                    self.flush_errors += 1
+                    oks = None
+                    if self._fallback_fn is not None:
+                        try:
+                            oks = self._fallback_fn(pks, msgs, sigs)
+                            self.fallback_flushes += 1
+                        except Exception:
+                            oks = None
+                    if oks is None:
+                        # fail closed, never hang callers
+                        oks = [False] * len(pks)
             if len(oks) != len(pks):  # misbehaving verifier: fail closed
                 oks = [False] * len(pks)
             self.flushes += 1

@@ -34,10 +34,20 @@ def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted(labels.items()))
 
 
+def _escape(v: str) -> str:
+    # Prometheus text format: label values escape backslash, quote, LF.
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _label_str(key: Tuple[Tuple[str, str], ...]) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 
@@ -71,6 +81,10 @@ class Counter(_Metric):
         with self._lock:
             items = sorted(self._values.items())
         if not items:
+            # A labeled metric with no samples exposes no series — a
+            # synthetic unlabeled `name 0` line would be invalid for it.
+            if self.label_names:
+                return []
             items = [((), 0.0)]
         return [
             f"{self.name}{_label_str(k)} {_fmt(v)}" for k, v in items
@@ -114,6 +128,8 @@ class Gauge(_Metric):
         with self._lock:
             items = sorted(self._values.items())
         if not items:
+            if self.label_names:
+                return []
             items = [((), 0.0)]
         return [
             f"{self.name}{_label_str(k)} {_fmt(v)}" for k, v in items
@@ -306,6 +322,13 @@ class ConsensusMetrics(_NopMixin):
         self.wal_writes = reg.counter(
             _name(s, "wal_writes"), "Consensus WAL records written."
         )
+        # Fed by the tracer's metrics observer (libs/tracing.py): one
+        # observation per consensus step span, same clock as the trace.
+        self.step_duration_seconds = reg.histogram(
+            _name(s, "step_duration_seconds"),
+            "Wall-clock duration of consensus step transitions, seconds.",
+            labels=("step",),
+        )
 
 
 
@@ -422,6 +445,23 @@ class OpsMetrics(_NopMixin):
         self.result_cache_misses = reg.counter(
             _name(s, "result_cache_misses_total"),
             "Verifications that missed the digest-keyed result cache.",
+        )
+        # Per-stage pipeline timing, fed by the tracer's metrics
+        # observer (libs/tracing.py): every span tagged stage+engine
+        # lands exactly one observation here.
+        self.verify_stage_seconds = reg.histogram(
+            _name(s, "verify_stage_seconds"),
+            "Per-stage latency of the batch verify pipeline, seconds.",
+            labels=("stage", "engine"),
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+            ),
+        )
+        self.inflight_lanes = reg.gauge(
+            _name(s, "inflight_lanes"),
+            "Signature lanes currently dispatched to the device.",
+            labels=("engine",),
         )
 
 

@@ -1,0 +1,350 @@
+"""Span tracing for the verify pipeline: Chrome-trace export, per-stage
+device timing, consensus step latency.
+
+The reference ships opaque wall-clock numbers; here every hot stage of
+the batch-verification pipeline (scheduler assembly, cache lookup, host
+prep, table gather, device dispatch, readback, CPU fallback) and every
+consensus step transition records a nestable span into a process-wide
+``Tracer``. Completed spans land in a bounded ring buffer and export as
+Chrome ``trace_events`` JSON, so a capture opens directly in
+``chrome://tracing`` / https://ui.perfetto.dev.
+
+Modes, driven by ``TENDERMINT_TPU_TRACE`` (or the ``[base] trace``
+config knob / ``--trace`` CLI flag):
+
+- ``off``  — spans are shared no-op objects; nothing is timed or stored
+  (unless a metrics observer is bound, in which case spans are timed for
+  the histograms but still not stored).
+- ``ring`` — completed spans accumulate in the in-memory ring buffer,
+  served at ``GET /debug/traces``.
+- ``<path>`` — ring behavior plus a Chrome-trace JSON dump written to
+  ``<path>`` at interpreter exit (and on explicit ``flush()``).
+
+Span durations double as metric samples: a bound observer (see
+``metrics_observer``) feeds spans tagged ``stage``+``engine`` into
+``tendermint_ops_verify_stage_seconds`` and spans tagged ``step`` into
+``tendermint_consensus_step_duration_seconds``, so the histograms and
+the trace always agree — one clock, one count.
+
+Nesting is per thread (a thread-local span stack); concurrency is safe
+because each thread only touches its own stack and the ring append
+takes the tracer lock.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+TRACE_ENV = "TENDERMINT_TPU_TRACE"
+CAP_ENV = "TENDERMINT_TPU_TRACE_CAP"
+DEFAULT_CAP = 4096
+
+OFF = "off"
+RING = "ring"
+
+
+class _NopSpan:
+    """Shared do-nothing span: the disabled tracer hands out this one
+    instance, so `with tracer.span(...)` costs an attribute lookup and
+    two no-op calls — no allocation, no clock reads."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NopSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+    def set(self, **tags: Any) -> None:
+        pass
+
+
+NOP_SPAN = _NopSpan()
+
+
+class _Span:
+    """One live span; a context manager recording on exit."""
+
+    __slots__ = ("_tracer", "name", "args", "parent", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self.parent = ""
+        self._t0 = 0.0
+
+    def set(self, **tags: Any) -> None:
+        """Attach tags discovered mid-span (hit counts, verdicts)."""
+        self.args.update(tags)
+
+    def __enter__(self) -> "_Span":
+        stack = self._tracer._stack()
+        if stack:
+            self.parent = stack[-1].name
+        stack.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        t1 = time.perf_counter()
+        stack = self._tracer._stack()
+        # Pop self specifically: a sibling span leaked across a generator
+        # boundary must not tear another thread of the stack.
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:
+            stack.remove(self)
+        self._tracer._complete(self, t1)
+        return False
+
+
+class Tracer:
+    """Thread-safe span recorder with a bounded ring of completed spans."""
+
+    def __init__(self, cap: int = DEFAULT_CAP):
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._ring: deque = deque(maxlen=cap)
+        self._mode = OFF
+        self._path: Optional[str] = None
+        self._recording = False
+        self._observer: Optional[Callable[[str, Dict[str, Any], float], None]] = None
+        self._epoch = time.perf_counter()
+        self._pid = os.getpid()
+        self._thread_names: Dict[int, str] = {}
+        self._atexit_registered = False
+        self.recorded = 0  # completed spans+instants accepted into the ring
+        self.dropped = 0  # evicted by the ring bound
+
+    # --- configuration -------------------------------------------------------
+
+    def configure(self, mode: Optional[str] = None) -> "Tracer":
+        """Set the mode: ``off`` | ``ring`` | a file path (ring + dump at
+        exit). ``None``/empty reads ``TENDERMINT_TPU_TRACE``."""
+        if not mode:
+            mode = os.environ.get(TRACE_ENV, OFF) or OFF
+        mode = mode.strip()
+        cap = DEFAULT_CAP
+        try:
+            cap = max(1, int(os.environ.get(CAP_ENV, DEFAULT_CAP)))
+        except ValueError:
+            pass
+        with self._lock:
+            self._mode = mode
+            self._path = None if mode in (OFF, RING) else mode
+            self._recording = mode != OFF
+            if self._ring.maxlen != cap:
+                self._ring = deque(self._ring, maxlen=cap)
+            if self._path and not self._atexit_registered:
+                self._atexit_registered = True
+                atexit.register(self.flush)
+        return self
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    @property
+    def enabled(self) -> bool:
+        return self._recording
+
+    def set_metrics_observer(
+        self, observer: Optional[Callable[[str, Dict[str, Any], float], None]]
+    ) -> None:
+        """Single observer slot (last binder wins, like
+        device_policy.bind_metrics): called with (name, args, seconds)
+        for every completed span, even in ``off`` mode, so metric
+        histograms stay live when the ring is not kept."""
+        with self._lock:
+            self._observer = observer
+
+    # --- recording -----------------------------------------------------------
+
+    def _stack(self) -> List[_Span]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def span(self, name: str, **args: Any) -> Any:
+        """``with tracer.span("prep_chunk", lane_count=n):`` — nested
+        spans inherit this one as parent (per-thread)."""
+        if not self._recording and self._observer is None:
+            return NOP_SPAN
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args: Any) -> None:
+        """Zero-duration event (device health transitions etc.)."""
+        if not self._recording:
+            return
+        ev = {
+            "name": name,
+            "ph": "i",
+            "s": "p",
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+            "ts": round((time.perf_counter() - self._epoch) * 1e6, 3),
+            "args": args,
+        }
+        self._append(ev)
+
+    def _complete(self, span: _Span, t1: float) -> None:
+        duration = t1 - span._t0
+        observer = self._observer
+        if observer is not None:
+            try:
+                observer(span.name, span.args, duration)
+            except Exception:
+                pass  # a broken metrics binding must not fail the traced op
+        if not self._recording:
+            return
+        args = span.args
+        if span.parent:
+            args.setdefault("parent", span.parent)
+        ev = {
+            "name": span.name,
+            "ph": "X",
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+            "ts": round((span._t0 - self._epoch) * 1e6, 3),
+            "dur": round(duration * 1e6, 3),
+            "args": args,
+        }
+        self._append(ev)
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        tid = ev["tid"]
+        name = threading.current_thread().name
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(ev)
+            self.recorded += 1
+            self._thread_names.setdefault(tid, name)
+
+    # --- export --------------------------------------------------------------
+
+    def export(
+        self, limit: Optional[int] = None, clear: bool = False
+    ) -> Dict[str, Any]:
+        """Chrome ``trace_events`` JSON object; ``limit`` keeps the most
+        recent N events (the response stays bounded)."""
+        with self._lock:
+            events = list(self._ring)
+            recorded, dropped = self.recorded, self.dropped
+            names = dict(self._thread_names)
+            if clear:
+                self._ring.clear()
+                self.dropped = 0
+        if limit is not None and len(events) > limit:
+            events = events[-limit:]
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": self._pid,
+                "tid": tid,
+                "args": {"name": tname},
+            }
+            for tid, tname in sorted(names.items())
+        ]
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "mode": self._mode,
+                "recorded": recorded,
+                "dropped": dropped,
+            },
+        }
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-stage p50/p95/total over the ring's completed spans,
+        grouped by the ``stage`` tag (falling back to the span name)."""
+        with self._lock:
+            events = [e for e in self._ring if e.get("ph") == "X"]
+        groups: Dict[str, List[float]] = {}
+        for ev in events:
+            key = str(ev["args"].get("stage") or ev["name"])
+            groups.setdefault(key, []).append(ev["dur"])
+        out: Dict[str, Dict[str, float]] = {}
+        for key in sorted(groups):
+            durs = sorted(groups[key])
+            n = len(durs)
+            out[key] = {
+                "count": n,
+                "p50_ms": round(durs[n // 2] / 1e3, 4),
+                "p95_ms": round(durs[min(n - 1, int(n * 0.95))] / 1e3, 4),
+                "total_ms": round(sum(durs) / 1e3, 4),
+            }
+        return out
+
+    def flush(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Chrome-trace JSON to ``path`` (default: the
+        configured file mode's path). No-op without a destination."""
+        path = path or self._path
+        if not path:
+            return None
+        try:
+            with open(path, "w") as f:
+                json.dump(self.export(), f)
+        except OSError:
+            return None
+        return path
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+def metrics_observer(ops=None, consensus=None):
+    """Bridge span durations into the metric histograms: spans tagged
+    ``stage`` + ``engine`` -> tendermint_ops_verify_stage_seconds, spans
+    tagged ``step`` -> tendermint_consensus_step_duration_seconds. One
+    timing source for both the trace and the histograms."""
+
+    def observe(name: str, args: Dict[str, Any], seconds: float) -> None:
+        stage = args.get("stage")
+        engine = args.get("engine")
+        if ops is not None and stage and engine:
+            ops.verify_stage_seconds.labels(
+                stage=str(stage), engine=str(engine)
+            ).observe(seconds)
+        step = args.get("step")
+        if consensus is not None and step:
+            consensus.step_duration_seconds.labels(step=str(step)).observe(
+                seconds
+            )
+
+    return observe
+
+
+# The process-wide instance every instrumentation site uses (the ops
+# modules have no node handle — same pattern as device_policy.shared).
+tracer = Tracer()
+tracer.configure()
+
+
+def configure(mode: Optional[str] = None) -> Tracer:
+    return tracer.configure(mode)
+
+
+def span(name: str, **args: Any) -> Any:
+    return tracer.span(name, **args)
+
+
+def instant(name: str, **args: Any) -> None:
+    tracer.instant(name, **args)

@@ -88,6 +88,11 @@ class NodeConfig:
     double_sign_check_height: int = 0
     # State sync (config/config.go StateSyncConfig): None disables.
     statesync: Optional["StateSyncConfig"] = None
+    # Verify-pipeline span tracing: "" inherits TENDERMINT_TPU_TRACE
+    # (default off), "ring" keeps a bounded in-memory ring served at
+    # GET /debug/traces, any other value is a Chrome-trace JSON path
+    # flushed at exit. "off" disables recording explicitly.
+    trace: str = ""
 
 
 class Node:
@@ -298,6 +303,18 @@ class Node:
         from tendermint_tpu.ops import precompute as _precompute
 
         _precompute.bind_metrics(ops_metrics)
+        # Span tracer: honor an explicit config knob (env otherwise), and
+        # feed span durations into the stage/step histograms regardless of
+        # whether the ring is recording.
+        from tendermint_tpu.libs import tracing as _tracing
+
+        if config.trace:
+            _tracing.configure(config.trace)
+        _tracing.tracer.set_metrics_observer(
+            _tracing.metrics_observer(
+                ops=ops_metrics, consensus=consensus_metrics
+            )
+        )
 
         # --- pools + executor (node.go:258-297) ------------------------------
         self.mempool = TxMempool(

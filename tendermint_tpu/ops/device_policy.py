@@ -45,6 +45,8 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
+from tendermint_tpu.libs import tracing
+
 # --- states ------------------------------------------------------------------
 
 HEALTHY = "healthy"
@@ -208,7 +210,14 @@ class DeviceHealth:
         return edge
 
     def _emit(self, edge: Optional[Tuple[str, str]], metrics) -> None:
-        if metrics is None or edge is None:
+        if edge is None:
+            return
+        # Instant trace event: health transitions line up against the
+        # verify-stage spans in the same Chrome-trace timeline.
+        tracing.instant(
+            "device_health_transition", from_state=edge[0], to_state=edge[1]
+        )
+        if metrics is None:
             return
         metrics.device_health_state.set(STATE_CODES[edge[1]])
         metrics.device_transitions.labels(
@@ -299,6 +308,14 @@ class DeviceHealth:
         if metrics is not None:
             metrics.device_fallbacks.labels(engine=engine).inc()
             metrics.device_fallback_lanes.labels(engine=engine).inc(lanes)
+
+    def note_inflight(self, engine: str, delta: int) -> None:
+        """Adjust the in-flight-lanes gauge: +lanes at chunk dispatch,
+        -lanes once the result is materialized (or fails to)."""
+        with self._mtx:
+            metrics = self._metrics
+        if metrics is not None:
+            metrics.inflight_lanes.labels(engine=engine).inc(delta)
 
 
 # The process-wide instance both engines share.

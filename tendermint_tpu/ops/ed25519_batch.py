@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tendermint_tpu.crypto.hashing import L, sha512_batch_mod_l
+from tendermint_tpu.libs import tracing
 from tendermint_tpu.ops import curve32 as curve, field32 as field
 
 _L_BYTES_BE = np.frombuffer(L.to_bytes(32, "big"), dtype=np.uint8)
@@ -751,30 +752,39 @@ def verify_batch(
     n = len(pubkeys)
     if n == 0:
         return []
-    if not precompute.result_cache_enabled():
-        return [bool(v) for v in _verify_uncached(pubkeys, msgs, sigs, backend)]
-    verdicts = np.zeros(n, dtype=bool)
-    pending = []
-    for i in range(n):
-        v = precompute.results.get(pubkeys[i], msgs[i], sigs[i])
-        if v is None:
-            pending.append(i)
-        else:
-            verdicts[i] = v
-    if pending:
-        if len(pending) == n:
-            sub = (pubkeys, msgs, sigs)
-        else:
-            sub = (
-                [pubkeys[i] for i in pending],
-                [msgs[i] for i in pending],
-                [sigs[i] for i in pending],
-            )
-        out = _verify_uncached(sub[0], sub[1], sub[2], backend)
-        for j, i in enumerate(pending):
-            verdicts[i] = out[j]
-            precompute.results.put(pubkeys[i], msgs[i], sigs[i], bool(out[j]))
-    return [bool(v) for v in verdicts]
+    with tracing.span("verify_batch", engine="ed25519", lanes=n):
+        if not precompute.result_cache_enabled():
+            return [
+                bool(v) for v in _verify_uncached(pubkeys, msgs, sigs, backend)
+            ]
+        verdicts = np.zeros(n, dtype=bool)
+        pending = []
+        with tracing.span(
+            "cache_lookup", stage="cache_lookup", engine="ed25519", lanes=n
+        ) as csp:
+            for i in range(n):
+                v = precompute.results.get(pubkeys[i], msgs[i], sigs[i])
+                if v is None:
+                    pending.append(i)
+                else:
+                    verdicts[i] = v
+            csp.set(hits=n - len(pending))
+        if pending:
+            if len(pending) == n:
+                sub = (pubkeys, msgs, sigs)
+            else:
+                sub = (
+                    [pubkeys[i] for i in pending],
+                    [msgs[i] for i in pending],
+                    [sigs[i] for i in pending],
+                )
+            out = _verify_uncached(sub[0], sub[1], sub[2], backend)
+            for j, i in enumerate(pending):
+                verdicts[i] = out[j]
+                precompute.results.put(
+                    pubkeys[i], msgs[i], sigs[i], bool(out[j])
+                )
+        return [bool(v) for v in verdicts]
 
 
 def _verify_uncached(
@@ -793,7 +803,10 @@ def _verify_uncached(
         # DISABLED, or cooling down (another caller may hold the probe
         # slot). Instant answer — the circuit breaker never blocks.
         health.count_fallback("ed25519", n)
-        return _host_verify_lanes(pubkeys, msgs, sigs, 0, n)
+        with tracing.span(
+            "host_fallback", stage="fallback", engine="ed25519", lanes=n
+        ):
+            return _host_verify_lanes(pubkeys, msgs, sigs, 0, n)
 
     # Partition: lanes whose key has a cached (or eligible, host-built)
     # table take the table kernel; ill-formed lanes must stay on the
@@ -817,20 +830,27 @@ def _verify_uncached(
     jobs += [_Job("legacy", rows) for rows in _chunk_rows(np.nonzero(~has_table)[0])]
 
     def prep_job(job: _Job) -> Tuple[dict, np.ndarray]:
-        pks = [pubkeys[i] for i in job.rows]
-        ms = [msgs[i] for i in job.rows]
-        sgs = [sigs[i] for i in job.rows]
-        pad_to = _bucket(len(job.rows))
-        if job.kind == "tables":
-            return _prep_table_chunk(
-                pks,
-                ms,
-                sgs,
-                [entries[i][0] for i in job.rows],
-                [entries[i][1] for i in job.rows],
-                pad_to,
-            )
-        return prepare_batch(pks, ms, sgs, pad_to=pad_to)
+        with tracing.span(
+            "prep_chunk",
+            stage="prep",
+            engine="ed25519",
+            kind=job.kind,
+            lanes=len(job.rows),
+        ):
+            pks = [pubkeys[i] for i in job.rows]
+            ms = [msgs[i] for i in job.rows]
+            sgs = [sigs[i] for i in job.rows]
+            pad_to = _bucket(len(job.rows))
+            if job.kind == "tables":
+                return _prep_table_chunk(
+                    pks,
+                    ms,
+                    sgs,
+                    [entries[i][0] for i in job.rows],
+                    [entries[i][1] for i in job.rows],
+                    pad_to,
+                )
+            return prepare_batch(pks, ms, sgs, pad_to=pad_to)
 
     results = np.ones(n, dtype=bool)
     host_ok_all = np.ones(n, dtype=bool)
@@ -867,7 +887,15 @@ def _verify_uncached(
                     runner = (
                         _run_chunk_tables if job.kind == "tables" else _run_chunk
                     )
-                    job.out = runner(inputs, backend)
+                    with tracing.span(
+                        "dispatch_chunk",
+                        stage="dispatch",
+                        engine="ed25519",
+                        kind=job.kind,
+                        lanes=len(job.rows),
+                    ):
+                        job.out = runner(inputs, backend)
+                    health.note_inflight("ed25519", len(job.rows))
                 except Exception as exc:
                     health.record_failure(exc, attempt)
                     attempt = None
@@ -893,8 +921,15 @@ def _verify_uncached(
         ok = None
         if job.out is not None:
             try:
-                fault_injection.fire("ed25519.collect")
-                ok = np.asarray(job.out)
+                with tracing.span(
+                    "collect_chunk",
+                    stage="collect",
+                    engine="ed25519",
+                    kind=job.kind,
+                    lanes=len(job.rows),
+                ):
+                    fault_injection.fire("ed25519.collect")
+                    ok = np.asarray(job.out)
                 device_chunks_ok += 1
             except Exception as exc:
                 health.record_failure(exc, attempt)
@@ -906,11 +941,21 @@ def _verify_uncached(
                     f"failed at collect ({exc!r}); CPU fallback for the "
                     f"chunk (device state={health.state})"
                 )
+            finally:
+                health.note_inflight("ed25519", -len(job.rows))
         if not len(job.rows):
             continue
         if ok is None:
             fallback_lanes += len(job.rows)
-            results[job.rows] = _host_verify_rows(pubkeys, msgs, sigs, job.rows)
+            with tracing.span(
+                "host_fallback",
+                stage="fallback",
+                engine="ed25519",
+                lanes=len(job.rows),
+            ):
+                results[job.rows] = _host_verify_rows(
+                    pubkeys, msgs, sigs, job.rows
+                )
             host_ok_all[job.rows] = True  # oracle verdicts are final
         else:
             results[job.rows] = ok[: len(job.rows)]

@@ -45,6 +45,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tendermint_tpu.libs import tracing
 from tendermint_tpu.ops import field32
 
 NLIMBS = 32
@@ -650,13 +651,38 @@ def verify_tables_fn(tab, a_ok, r_bytes, s_bytes, k_bytes, *, block: int, interp
     return out[0] != 0.0
 
 
+def _trace_first_call(fn, kernel: str, n: int):
+    """Wrap a jitted kernel so its FIRST invocation — the one that pays
+    Pallas trace + XLA compile — records a ``kernel_compile`` span;
+    steady-state calls go straight through with zero overhead."""
+    compiled = False
+
+    def run(*args):
+        nonlocal compiled
+        if not compiled:
+            compiled = True
+            with tracing.span(
+                "kernel_compile", kernel=kernel, lanes=n, impl="pallas"
+            ):
+                return fn(*args)
+        return fn(*args)
+
+    return run
+
+
 @lru_cache(maxsize=8)
 def compiled_verify(n: int, block: int = BLOCK, interpret: bool = False):
     """Jitted end-to-end verify for a fixed padded batch size n."""
     blk = min(block, n)
     assert n % blk == 0, (n, blk)
-    return jax.jit(
-        lambda pk, r, s, k: verify_fn(pk, r, s, k, block=blk, interpret=interpret)
+    return _trace_first_call(
+        jax.jit(
+            lambda pk, r, s, k: verify_fn(
+                pk, r, s, k, block=blk, interpret=interpret
+            )
+        ),
+        "verify",
+        n,
     )
 
 
@@ -665,8 +691,12 @@ def compiled_verify_tables(n: int, block: int = BLOCK, interpret: bool = False):
     """Jitted table-input verify for a fixed padded batch size n."""
     blk = min(block, n)
     assert n % blk == 0, (n, blk)
-    return jax.jit(
-        lambda tab, ok, r, s, k: verify_tables_fn(
-            tab, ok, r, s, k, block=blk, interpret=interpret
-        )
+    return _trace_first_call(
+        jax.jit(
+            lambda tab, ok, r, s, k: verify_tables_fn(
+                tab, ok, r, s, k, block=blk, interpret=interpret
+            )
+        ),
+        "verify_tables",
+        n,
     )

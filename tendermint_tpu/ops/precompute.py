@@ -49,6 +49,8 @@ from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tendermint_tpu.libs import tracing
+
 TABLE_WIDTH = 8  # signed 4-bit windows select from [1..8](-A)
 NLIMBS = 32
 
@@ -265,51 +267,55 @@ class PrecomputeCache:
         if not table_cache_enabled():
             return None, has_table
         entries: List[Optional[Tuple[np.ndarray, bool]]] = [None] * n
-        with self._lock:
-            metrics = self._metrics
-            hits = misses = builds = 0
-            build_time = 0.0
-            seen: Dict[bytes, int] = {}
-            for i, pk in enumerate(pubkeys):
-                pk = bytes(pk)
-                entry = self._entries.get(pk)
-                if entry is not None:
-                    self._entries.move_to_end(pk)
-                    hits += 1
-                elif pk in seen:
-                    # duplicate signer inside one batch: one build serves
-                    # every lane, and only the first counts as a miss.
-                    entry = entries[seen[pk]]
-                    if entry is None:  # first occurrence was ineligible
+        with tracing.span(
+            "gather_tables", stage="gather", engine="ed25519", lanes=n
+        ) as tspan:
+            with self._lock:
+                metrics = self._metrics
+                hits = misses = builds = 0
+                build_time = 0.0
+                seen: Dict[bytes, int] = {}
+                for i, pk in enumerate(pubkeys):
+                    pk = bytes(pk)
+                    entry = self._entries.get(pk)
+                    if entry is not None:
+                        self._entries.move_to_end(pk)
+                        hits += 1
+                    elif pk in seen:
+                        # duplicate signer inside one batch: one build serves
+                        # every lane, and only the first counts as a miss.
+                        entry = entries[seen[pk]]
+                        if entry is None:  # first occurrence was ineligible
+                            continue
+                    elif self._eligible_for_build(pk):
+                        misses += 1
+                        t0 = time.perf_counter()
+                        table, ok = build_table(pk)
+                        build_time += time.perf_counter() - t0
+                        builds += 1
+                        entry = (table, ok)
+                        self._insert_locked(pk, table, ok)
+                    else:
+                        misses += 1
+                        has_table[i] = False
+                        seen.setdefault(pk, i)
                         continue
-                elif self._eligible_for_build(pk):
-                    misses += 1
-                    t0 = time.perf_counter()
-                    table, ok = build_table(pk)
-                    build_time += time.perf_counter() - t0
-                    builds += 1
-                    entry = (table, ok)
-                    self._insert_locked(pk, table, ok)
-                else:
-                    misses += 1
-                    has_table[i] = False
+                    entries[i] = entry
+                    has_table[i] = True
                     seen.setdefault(pk, i)
-                    continue
-                entries[i] = entry
-                has_table[i] = True
-                seen.setdefault(pk, i)
-            self.hits += hits
-            self.misses += misses
-            self.builds += builds
-            self.build_seconds += build_time
-        if metrics is not None:
-            if hits:
-                metrics.precompute_hits.inc(hits)
-            if misses:
-                metrics.precompute_misses.inc(misses)
-            if builds:
-                metrics.precompute_builds.inc(builds)
-                metrics.table_build_seconds.observe(build_time)
+                self.hits += hits
+                self.misses += misses
+                self.builds += builds
+                self.build_seconds += build_time
+            tspan.set(hits=hits, misses=misses, builds=builds)
+            if metrics is not None:
+                if hits:
+                    metrics.precompute_hits.inc(hits)
+                if misses:
+                    metrics.precompute_misses.inc(misses)
+                if builds:
+                    metrics.precompute_builds.inc(builds)
+                    metrics.table_build_seconds.observe(build_time)
         if not has_table.any():
             return None, has_table
         return entries, has_table

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tendermint_tpu.libs import tracing
 from tendermint_tpu.ops import curve32 as curve, field32 as field
 from tendermint_tpu.ops.ed25519_batch import (
     CHUNK,
@@ -189,7 +190,12 @@ def verify_batch_sr(
     attempt = health.begin_attempt("sr25519")
     if attempt is None:
         health.count_fallback("sr25519", n)
-        return [verify_host(p, m, s) for p, m, s in zip(pubkeys, msgs, sigs)]
+        with tracing.span(
+            "host_fallback", stage="fallback", engine="sr25519", lanes=n
+        ):
+            return [
+                verify_host(p, m, s) for p, m, s in zip(pubkeys, msgs, sigs)
+            ]
 
     host_ok = np.ones(n, dtype=bool)
     pk_arr = np.zeros((n, 32), dtype=np.uint8)
@@ -234,24 +240,31 @@ def verify_batch_sr(
     def prep_chunk(lo: int, hi: int):
         """Merlin challenges + padding for lanes [lo, hi) — the host
         half of the double buffer."""
-        top = min(hi, n)
-        k_c = np.zeros((hi - lo, 32), dtype=np.uint8)
-        for i in range(lo, top):
-            if has_fields[i]:
-                k = _challenge(_signing_transcript(msgs[i]), pubkeys[i], sigs[i][:32])
-                k_c[i - lo] = np.frombuffer(
-                    k.to_bytes(32, "little"), dtype=np.uint8
+        with tracing.span(
+            "prep_chunk", stage="prep", engine="sr25519", lanes=hi - lo
+        ):
+            top = min(hi, n)
+            k_c = np.zeros((hi - lo, 32), dtype=np.uint8)
+            for i in range(lo, top):
+                if has_fields[i]:
+                    k = _challenge(
+                        _signing_transcript(msgs[i]), pubkeys[i], sigs[i][:32]
+                    )
+                    k_c[i - lo] = np.frombuffer(
+                        k.to_bytes(32, "little"), dtype=np.uint8
+                    )
+            if hi > top:
+                pad_pk, pad_r, pad_s, pad_k = pad
+                npad = hi - top
+                pk_c = np.concatenate(
+                    [pk_arr[lo:top], np.tile(pad_pk, (npad, 1))]
                 )
-        if hi > top:
-            pad_pk, pad_r, pad_s, pad_k = pad
-            npad = hi - top
-            pk_c = np.concatenate([pk_arr[lo:top], np.tile(pad_pk, (npad, 1))])
-            r_c = np.concatenate([r_arr[lo:top], np.tile(pad_r, (npad, 1))])
-            s_c = np.concatenate([s_arr[lo:top], np.tile(pad_s, (npad, 1))])
-            k_c[top - lo :] = pad_k
-        else:
-            pk_c, r_c, s_c = pk_arr[lo:hi], r_arr[lo:hi], s_arr[lo:hi]
-        return pk_c, r_c, s_c, k_c
+                r_c = np.concatenate([r_arr[lo:top], np.tile(pad_r, (npad, 1))])
+                s_c = np.concatenate([s_arr[lo:top], np.tile(pad_s, (npad, 1))])
+                k_c[top - lo :] = pad_k
+            else:
+                pk_c, r_c, s_c = pk_arr[lo:hi], r_arr[lo:hi], s_arr[lo:hi]
+            return pk_c, r_c, s_c, k_c
 
     # Double-buffered dispatch: enqueue chunk j's kernel (async), then
     # hash chunk j+1's challenges while the device crunches chunk j. A
@@ -280,10 +293,17 @@ def verify_batch_sr(
                 attempt = health.begin_attempt("sr25519")
             if attempt is not None:
                 try:
-                    fault_injection.fire("sr25519.chunk")
-                    out = _compiled_kernel_sr(hi - lo, backend, mul_impl)(
-                        *(jnp.asarray(a) for a in preps[ci])
-                    )
+                    with tracing.span(
+                        "dispatch_chunk",
+                        stage="dispatch",
+                        engine="sr25519",
+                        lanes=hi - lo,
+                    ):
+                        fault_injection.fire("sr25519.chunk")
+                        out = _compiled_kernel_sr(hi - lo, backend, mul_impl)(
+                            *(jnp.asarray(a) for a in preps[ci])
+                        )
+                    health.note_inflight("sr25519", hi - lo)
                 except Exception as exc:
                     health.record_failure(exc, attempt)
                     attempt = None
@@ -318,7 +338,13 @@ def verify_batch_sr(
         ok = None
         if out is not None:
             try:
-                ok = np.asarray(out)
+                with tracing.span(
+                    "collect_chunk",
+                    stage="collect",
+                    engine="sr25519",
+                    lanes=hi - lo,
+                ):
+                    ok = np.asarray(out)
                 device_chunks_ok += 1
             except Exception as exc:
                 health.record_failure(exc, attempt)
@@ -329,18 +355,26 @@ def verify_batch_sr(
                     f"sr25519 device chunk [{lo}:{hi}] failed at collect "
                     f"({exc!r}); CPU fallback (device state={health.state})"
                 )
+            finally:
+                health.note_inflight("sr25519", -(hi - lo))
         if ok is None:
             ok = np.ones(hi - lo, dtype=bool)
             top = min(hi, n)  # padded lanes need no host verify
             if lo < top:
                 fallback_lanes += top - lo
-                ok[: top - lo] = np.array(
-                    [
-                        verify_host(pubkeys[i], msgs[i], sigs[i])
-                        for i in range(lo, top)
-                    ],
-                    dtype=bool,
-                )
+                with tracing.span(
+                    "host_fallback",
+                    stage="fallback",
+                    engine="sr25519",
+                    lanes=top - lo,
+                ):
+                    ok[: top - lo] = np.array(
+                        [
+                            verify_host(pubkeys[i], msgs[i], sigs[i])
+                            for i in range(lo, top)
+                        ],
+                        dtype=bool,
+                    )
         results[lo:hi] = ok
 
     if fallback_lanes:

@@ -114,6 +114,23 @@ class RPCServer:
                 if method == "":
                     self._send(200, server._index().encode())
                     return
+                if method == "debug/traces":
+                    # Chrome-trace JSON export of the global span tracer;
+                    # bounded by the tracer's ring capacity. ?limit=N caps
+                    # the event count, ?clear=1 drains the ring after read.
+                    from tendermint_tpu.libs import tracing
+
+                    q = dict(parse_qsl(parsed.query))
+                    try:
+                        limit = int(q["limit"]) if "limit" in q else None
+                    except ValueError:
+                        limit = None
+                    clear = q.get("clear") in ("1", "true")
+                    body = json.dumps(
+                        tracing.tracer.export(limit=limit, clear=clear)
+                    ).encode()
+                    self._send(200, body)
+                    return
                 if method == "metrics" and server.metrics_registry is not None:
                     body = server.metrics_registry.expose().encode()
                     self.send_response(200)

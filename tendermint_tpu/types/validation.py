@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Callable, NamedTuple, Optional
 
 from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.libs import tracing
 from tendermint_tpu.types.block import BlockID, Commit, CommitSig, BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT
 from tendermint_tpu.types.validator_set import ValidatorSet
 
@@ -64,34 +65,51 @@ def verify_commit(
 ) -> None:
     """validation.go:28-54: +2/3 signed; checks ALL signatures (ABCI apps
     depend on the full LastCommitInfo for incentivization)."""
-    _verify_basic_vals_and_commit(vals, commit, height, block_id)
-    voting_power_needed = vals.total_voting_power() * 2 // 3
-    ignore = lambda c: c.block_id_flag == BLOCK_ID_FLAG_ABSENT
-    count = lambda c: c.block_id_flag == BLOCK_ID_FLAG_COMMIT
-    if _should_batch_verify(vals, commit):
-        return _verify_commit_batch(
-            chain_id, vals, commit, voting_power_needed, ignore, count, True, True
+    with tracing.span(
+        "verify_commit",
+        height=height,
+        round=commit.round,
+        sigs=len(commit.signatures),
+    ):
+        _verify_basic_vals_and_commit(vals, commit, height, block_id)
+        voting_power_needed = vals.total_voting_power() * 2 // 3
+        ignore = lambda c: c.block_id_flag == BLOCK_ID_FLAG_ABSENT
+        count = lambda c: c.block_id_flag == BLOCK_ID_FLAG_COMMIT
+        if _should_batch_verify(vals, commit):
+            return _verify_commit_batch(
+                chain_id, vals, commit, voting_power_needed, ignore, count,
+                True, True,
+            )
+        return _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            True, True,
         )
-    return _verify_commit_single(
-        chain_id, vals, commit, voting_power_needed, ignore, count, True, True
-    )
 
 
 def verify_commit_light(
     chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
 ) -> None:
     """validation.go:58-87: light-client/blocksync variant; stops at +2/3."""
-    _verify_basic_vals_and_commit(vals, commit, height, block_id)
-    voting_power_needed = vals.total_voting_power() * 2 // 3
-    ignore = lambda c: c.block_id_flag != BLOCK_ID_FLAG_COMMIT
-    count = lambda c: True
-    if _should_batch_verify(vals, commit):
-        return _verify_commit_batch(
-            chain_id, vals, commit, voting_power_needed, ignore, count, False, True
+    with tracing.span(
+        "verify_commit",
+        mode="light",
+        height=height,
+        round=commit.round,
+        sigs=len(commit.signatures),
+    ):
+        _verify_basic_vals_and_commit(vals, commit, height, block_id)
+        voting_power_needed = vals.total_voting_power() * 2 // 3
+        ignore = lambda c: c.block_id_flag != BLOCK_ID_FLAG_COMMIT
+        count = lambda c: True
+        if _should_batch_verify(vals, commit):
+            return _verify_commit_batch(
+                chain_id, vals, commit, voting_power_needed, ignore, count,
+                False, True,
+            )
+        return _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            False, True,
         )
-    return _verify_commit_single(
-        chain_id, vals, commit, voting_power_needed, ignore, count, False, True
-    )
 
 
 def verify_commit_light_trusting(

@@ -1,12 +1,13 @@
 """Metrics + structured logging tests (metricsgen/libs-log analogs).
 
-Instrument semantics, Prometheus text exposition, the logger's level and
-field behavior, and a live node serving real consensus metrics over
-``GET /metrics``.
+Instrument semantics, Prometheus text exposition conformance, the
+logger's level and field behavior, the ``/debug/traces`` endpoint, and a
+live node serving real consensus metrics over ``GET /metrics``.
 """
 
 import io
 import json
+import re
 import urllib.request
 
 import pytest
@@ -18,7 +19,10 @@ from tendermint_tpu.libs.metrics import (
     Gauge,
     Histogram,
     MempoolMetrics,
+    OpsMetrics,
+    P2PMetrics,
     Registry,
+    StateMetrics,
 )
 
 
@@ -84,6 +88,169 @@ class TestInstruments:
         m = ConsensusMetrics.nop()
         m.height.set(5)  # must not raise, registers nowhere
         m.total_txs.inc()
+
+
+class TestLabeledZeroSamples:
+    def test_labeled_counter_with_no_samples_exposes_no_series(self):
+        c = Counter("reqs_total", "help", ("code",))
+        assert c.collect() == []
+
+    def test_labeled_gauge_with_no_samples_exposes_no_series(self):
+        g = Gauge("lanes", "help", ("engine",))
+        assert g.collect() == []
+
+    def test_unlabeled_zero_state_still_exposed(self):
+        # zero-config instruments keep their `name 0` line: scrapers
+        # see the series exists before the first increment
+        assert Counter("a_total", "h").collect() == ["a_total 0"]
+        assert Gauge("b", "h").collect() == ["b 0"]
+
+    def test_label_values_escaped(self):
+        c = Counter("errs_total", "help", ("reason",))
+        c.labels(reason='quote " backslash \\ newline \n end').inc()
+        (line,) = c.collect()
+        assert line == (
+            'errs_total{reason="quote \\" backslash \\\\ '
+            'newline \\n end"} 1'
+        )
+
+
+# --- exposition conformance --------------------------------------------------
+
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_exposition(text):
+    """Minimal Prometheus text-format parser: returns ({name: type},
+    {name: help}, [(series_name, {labels}, value)])."""
+    types, helps, series = {}, {}, []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name, _, h = line[len("# HELP "):].partition(" ")
+            helps[name] = h
+            continue
+        if line.startswith("# TYPE "):
+            name, _, t = line[len("# TYPE "):].partition(" ")
+            types[name] = t
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line}"
+        lhs, _, value = line.rpartition(" ")
+        assert lhs and value, f"malformed series line: {line}"
+        if "{" in lhs:
+            sname, _, rest = lhs.partition("{")
+            assert rest.endswith("}"), f"unclosed label set: {line}"
+            labels = dict(_LABEL_RE.findall(rest[:-1]))
+        else:
+            sname, labels = lhs, {}
+        series.append((sname, labels, float(value)))
+    return types, helps, series
+
+
+def _populated_full_registry():
+    reg = Registry()
+    consensus = ConsensusMetrics(reg)
+    MempoolMetrics(reg)
+    P2PMetrics(reg)
+    StateMetrics(reg)
+    ops = OpsMetrics(reg)
+    consensus.height.set(7)
+    consensus.step_duration_seconds.labels(step="propose").observe(0.004)
+    consensus.step_duration_seconds.labels(step="commit").observe(2.0)
+    ops.verify_stage_seconds.labels(stage="prep", engine="ed25519").observe(
+        0.0004
+    )
+    ops.verify_stage_seconds.labels(stage="prep", engine="ed25519").observe(
+        0.2
+    )
+    ops.inflight_lanes.labels(engine="ed25519").inc(64)
+    ops.precompute_hits.inc(3)
+    return reg
+
+
+class TestExpositionConformance:
+    def test_every_series_has_type_and_help(self):
+        reg = _populated_full_registry()
+        types, helps, series = _parse_exposition(reg.expose())
+        assert set(types) == set(helps)  # pairing
+        for sname, _labels, _v in series:
+            base = sname
+            for suffix in ("_bucket", "_sum", "_count"):
+                if sname.endswith(suffix) and sname[: -len(suffix)] in types:
+                    base = sname[: -len(suffix)]
+                    break
+            assert base in types, f"series {sname} lacks # TYPE"
+            if base != sname:
+                assert types[base] == "histogram"
+
+    def test_histogram_buckets_cumulative_monotone(self):
+        reg = _populated_full_registry()
+        types, _helps, series = _parse_exposition(reg.expose())
+        groups = {}
+        counts = {}
+        for sname, labels, v in series:
+            if sname.endswith("_bucket"):
+                base = sname[: -len("_bucket")]
+                key = (base, tuple(sorted(
+                    (k, lv) for k, lv in labels.items() if k != "le"
+                )))
+                groups.setdefault(key, []).append((labels["le"], v))
+            elif sname.endswith("_count") and types.get(
+                sname[: -len("_count")]
+            ) == "histogram":
+                counts[(sname[: -len("_count")], tuple(sorted(
+                    labels.items()
+                )))] = v
+        assert groups  # the registry does expose histograms
+        for key, buckets in groups.items():
+            finite = [
+                (float(le), v) for le, v in buckets if le != "+Inf"
+            ]
+            finite.sort()
+            values = [v for _le, v in finite]
+            assert values == sorted(values), f"non-monotone buckets: {key}"
+            inf = [v for le, v in buckets if le == "+Inf"]
+            assert len(inf) == 1
+            assert inf[0] >= (values[-1] if values else 0)
+            assert counts[key] == inf[0]  # +Inf bucket equals _count
+
+    def test_no_unlabeled_series_for_labeled_metrics(self):
+        reg = _populated_full_registry()
+        _types, _helps, series = _parse_exposition(reg.expose())
+        labeled = {
+            m.name: set(m.label_names)
+            for m in reg._metrics
+            if m.label_names
+        }
+        for sname, labels, _v in series:
+            for base, names in labeled.items():
+                if sname == base or (
+                    sname.startswith(base + "_")
+                    and sname[len(base):] in ("_bucket", "_sum", "_count")
+                ):
+                    got = set(labels) - {"le"}
+                    assert got == names, (
+                        f"{sname}: expected labels {names}, got {got}"
+                    )
+
+
+class TestMetricsAudit:
+    def test_no_dead_instruments(self):
+        import importlib.util
+        import os
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts",
+            "check_metrics.py",
+        )
+        spec = importlib.util.spec_from_file_location("check_metrics", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.find_dead_instruments() == []
+        # and the audit actually saw the instrument inventory
+        assert len(mod.declared_instruments()) >= 30
 
 
 class TestLogger:
@@ -183,3 +350,78 @@ class TestLiveNodeMetrics:
             assert "tendermint_p2p_peers" in metrics
         finally:
             node.stop()
+
+
+class TestDebugTracesEndpoint:
+    """GET /debug/traces serves the global tracer's Chrome-trace JSON —
+    exercised against a bare RPCServer (the same handler the node's
+    operator surface mounts next to /metrics)."""
+
+    @pytest.fixture
+    def server(self):
+        from tendermint_tpu.rpc.server import RPCServer
+
+        srv = RPCServer(routes={}, metrics_registry=Registry())
+        srv.start()
+        yield srv
+        srv.stop()
+
+    @pytest.fixture
+    def ring_tracer(self):
+        from tendermint_tpu.libs import tracing
+
+        tracing.tracer.set_metrics_observer(None)
+        tracing.configure("ring")
+        tracing.tracer.clear()
+        yield tracing.tracer
+        tracing.configure("off")
+        tracing.tracer.clear()
+
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            return json.loads(resp.read().decode())
+
+    def test_serves_bounded_valid_json(self, server, ring_tracer):
+        from tendermint_tpu.libs import tracing
+
+        for i in range(12):
+            with tracing.span("rpc_traced", i=i):
+                pass
+        doc = self._get(f"{server.url}/debug/traces")
+        spans = [
+            e for e in doc["traceEvents"] if e.get("name") == "rpc_traced"
+        ]
+        assert len(spans) == 12
+        assert doc["displayTimeUnit"] == "ms"
+        assert doc["otherData"]["mode"] == "ring"
+
+        # ?limit bounds the response
+        doc = self._get(f"{server.url}/debug/traces?limit=5")
+        spans = [
+            e for e in doc["traceEvents"] if e.get("name") == "rpc_traced"
+        ]
+        assert len(spans) == 5
+        assert [e["args"]["i"] for e in spans] == list(range(7, 12))
+
+    def test_clear_drains_ring(self, server, ring_tracer):
+        from tendermint_tpu.libs import tracing
+
+        with tracing.span("once"):
+            pass
+        self._get(f"{server.url}/debug/traces?clear=1")
+        doc = self._get(f"{server.url}/debug/traces")
+        assert not [
+            e for e in doc["traceEvents"] if e.get("ph") == "X"
+        ]
+
+    def test_off_mode_serves_empty_document(self, server):
+        from tendermint_tpu.libs import tracing
+
+        tracing.configure("off")
+        tracing.tracer.clear()
+        doc = self._get(f"{server.url}/debug/traces")
+        assert doc["otherData"]["mode"] == "off"
+        assert not [
+            e for e in doc["traceEvents"] if e.get("ph") == "X"
+        ]

@@ -1,0 +1,399 @@
+"""Span tracer tests: nesting, concurrency, ring bounds, nop overhead,
+and the end-to-end verify-pipeline acceptance capture.
+
+The tracer under test is the process-global ``tendermint_tpu.libs.
+tracing.tracer`` (instrumentation sites have no handle to pass one in),
+so every test here configures it explicitly and restores ``off`` +
+observer-free state on exit via the ``ring`` fixture.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from tendermint_tpu.libs import tracing
+from tendermint_tpu.libs.metrics import (
+    ConsensusMetrics,
+    OpsMetrics,
+    Registry,
+)
+
+from tests.helpers import CHAIN_ID, make_block_id, make_commit, make_validators
+
+
+@pytest.fixture
+def ring(monkeypatch):
+    """Global tracer in ring mode, restored to off/empty afterwards."""
+    monkeypatch.delenv(tracing.CAP_ENV, raising=False)
+    tracing.configure("ring")
+    tracing.tracer.clear()
+    tracing.tracer.set_metrics_observer(None)
+    yield tracing.tracer
+    tracing.tracer.set_metrics_observer(None)
+    tracing.configure("off")
+    tracing.tracer.clear()
+
+
+def _complete_events(exported):
+    return [e for e in exported["traceEvents"] if e.get("ph") == "X"]
+
+
+# --- basic recording ---------------------------------------------------------
+
+
+def test_nested_spans_record_parent_and_args(ring):
+    with tracing.span("outer", height=7):
+        with tracing.span("inner", stage="prep", engine="ed25519") as sp:
+            sp.set(lanes=42)
+    out = ring.export()
+    events = {e["name"]: e for e in _complete_events(out)}
+    assert set(events) == {"outer", "inner"}
+    assert events["outer"]["args"]["height"] == 7
+    assert "parent" not in events["outer"]["args"]
+    assert events["inner"]["args"]["parent"] == "outer"
+    assert events["inner"]["args"]["lanes"] == 42
+    # inner completes first and sits inside outer's time window
+    inner, outer = events["inner"], events["outer"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert out["displayTimeUnit"] == "ms"
+    assert out["otherData"]["mode"] == "ring"
+
+
+def test_instant_events(ring):
+    tracing.instant("device_health_transition", from_state="healthy")
+    (ev,) = ring.export()["traceEvents"][-1:]
+    assert ev["ph"] == "i"
+    assert ev["s"] == "p"
+    assert ev["args"]["from_state"] == "healthy"
+
+
+def test_export_is_valid_bounded_json(ring):
+    for i in range(10):
+        with tracing.span("s", i=i):
+            pass
+    out = ring.export(limit=4)
+    assert len(_complete_events(out)) == 4
+    # the wire form of /debug/traces round-trips through json
+    assert json.loads(json.dumps(out)) == out
+
+
+def test_export_clear_drains_ring(ring):
+    with tracing.span("s"):
+        pass
+    assert len(ring) == 1
+    ring.export(clear=True)
+    assert len(ring) == 0
+
+
+# --- concurrency -------------------------------------------------------------
+
+
+def test_concurrent_threads_yield_well_nested_untorn_output(ring):
+    """≥4 threads race nested spans; every event must carry intact args
+    and per-thread parent attribution (no cross-thread tearing)."""
+    n_threads, n_iters = 6, 25
+    barrier = threading.Barrier(n_threads)
+    errors = []
+
+    def work(t):
+        try:
+            barrier.wait(timeout=10)
+            for i in range(n_iters):
+                with tracing.span(f"outer-{t}", t=t, i=i):
+                    with tracing.span(f"inner-{t}", t=t, i=i):
+                        pass
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=work, args=(t,)) for t in range(n_threads)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert not errors
+    out = ring.export()
+    events = _complete_events(out)
+    assert len(events) == n_threads * n_iters * 2
+    # untorn: the JSON form parses back identical
+    assert json.loads(json.dumps(out)) == out
+    for ev in events:
+        t = ev["args"]["t"]
+        assert ev["name"] in (f"outer-{t}", f"inner-{t}")
+        if ev["name"].startswith("inner"):
+            # nesting never crosses threads: the parent is this
+            # thread's own outer span, regardless of interleaving
+            assert ev["args"]["parent"] == f"outer-{t}"
+        else:
+            assert "parent" not in ev["args"]
+    # each thread's events landed under its own tid
+    by_tid = {}
+    for ev in events:
+        by_tid.setdefault(ev["tid"], set()).add(ev["args"]["t"])
+    assert all(len(owners) == 1 for owners in by_tid.values())
+
+
+# --- ring bound --------------------------------------------------------------
+
+
+def test_ring_bound_enforced(ring, monkeypatch):
+    monkeypatch.setenv(tracing.CAP_ENV, "8")
+    tracing.configure("ring")
+    tracing.tracer.clear()
+    for i in range(20):
+        with tracing.span("s", i=i):
+            pass
+    assert len(tracing.tracer) == 8
+    out = tracing.tracer.export()
+    events = _complete_events(out)
+    # most recent events survive
+    assert [e["args"]["i"] for e in events] == list(range(12, 20))
+    assert out["otherData"]["dropped"] == 12
+
+
+# --- nop path ----------------------------------------------------------------
+
+
+def test_nop_tracer_adds_no_spans():
+    tracing.tracer.set_metrics_observer(None)
+    tracing.configure("off")
+    tracing.tracer.clear()
+    before = tracing.tracer.recorded
+    for _ in range(100):
+        with tracing.span("hot", lanes=1) as sp:
+            sp.set(x=1)
+        tracing.instant("tick")
+    # counter-asserted, not timing-asserted: nothing was recorded and
+    # the disabled span is the one shared nop instance
+    assert tracing.tracer.recorded == before
+    assert len(tracing.tracer) == 0
+    assert tracing.span("hot") is tracing.NOP_SPAN
+
+
+def test_off_mode_with_observer_times_spans_without_storing():
+    seen = []
+    tracing.configure("off")
+    tracing.tracer.clear()
+    tracing.tracer.set_metrics_observer(
+        lambda name, args, sec: seen.append((name, dict(args), sec))
+    )
+    try:
+        with tracing.span("stage_span", stage="prep", engine="ed25519"):
+            pass
+        assert len(tracing.tracer) == 0  # ring stays empty in off mode
+        assert len(seen) == 1
+        name, args, sec = seen[0]
+        assert name == "stage_span"
+        assert args["stage"] == "prep"
+        assert sec >= 0.0
+    finally:
+        tracing.tracer.set_metrics_observer(None)
+
+
+def test_broken_observer_never_fails_the_traced_op(ring):
+    def boom(name, args, sec):
+        raise RuntimeError("broken metrics binding")
+
+    ring.set_metrics_observer(boom)
+    with tracing.span("s"):
+        pass
+    assert len(ring) == 1
+
+
+# --- summary -----------------------------------------------------------------
+
+
+def test_summary_groups_by_stage_tag(ring):
+    for _ in range(3):
+        with tracing.span("prep_chunk", stage="prep", engine="ed25519"):
+            pass
+    with tracing.span("verify_batch", engine="ed25519"):
+        pass
+    s = ring.summary()
+    assert s["prep"]["count"] == 3
+    assert s["verify_batch"]["count"] == 1
+    for row in s.values():
+        assert row["p50_ms"] <= row["p95_ms"] or row["count"] == 1
+        assert row["total_ms"] >= row["p50_ms"] >= 0
+
+
+# --- metrics observer bridge -------------------------------------------------
+
+
+def test_metrics_observer_feeds_both_histograms():
+    reg = Registry()
+    ops = OpsMetrics(reg)
+    consensus = ConsensusMetrics(reg)
+    obs = tracing.metrics_observer(ops=ops, consensus=consensus)
+    obs("prep_chunk", {"stage": "prep", "engine": "ed25519"}, 0.001)
+    obs("propose", {"step": "propose", "height": 1}, 0.002)
+    obs("verify_batch", {"engine": "ed25519"}, 0.003)  # no stage: skipped
+    text = reg.expose()
+    assert (
+        'tendermint_ops_verify_stage_seconds_count'
+        '{engine="ed25519",stage="prep"} 1' in text
+    )
+    assert (
+        'tendermint_consensus_step_duration_seconds_count'
+        '{step="propose"} 1' in text
+    )
+
+
+# --- end-to-end: verify_commit under ring tracing ----------------------------
+
+
+def _stage_counts_from_events(events):
+    counts = {}
+    for ev in events:
+        stage = ev["args"].get("stage")
+        engine = ev["args"].get("engine")
+        if stage and engine:
+            counts[(stage, engine)] = counts.get((stage, engine), 0) + 1
+    return counts
+
+
+def _histogram_counts(ops):
+    hist = ops.verify_stage_seconds
+    with hist._lock:
+        return {
+            (dict(k)["stage"], dict(k)["engine"]): n
+            for k, (_c, _t, n) in hist._values.items()
+        }
+
+
+def test_verify_commit_traced_end_to_end(ring, monkeypatch):
+    """The acceptance capture: a 24-validator commit verified with
+    TENDERMINT_TPU_TRACE=ring records the nested pipeline (consensus
+    span -> batch verify -> cache lookup / per-chunk prep+dispatch),
+    and the stage histogram counts equal the traced stage-span counts."""
+    from tendermint_tpu.ops import precompute
+    from tendermint_tpu.types import validation
+
+    monkeypatch.setenv("TENDERMINT_TPU_TRACE", "ring")
+    monkeypatch.setenv(precompute._RESULT_ENV, "1")  # conftest turns it off
+    precompute.reset()
+    reg = Registry()
+    ops = OpsMetrics(reg)
+    consensus = ConsensusMetrics(reg)
+    ring.set_metrics_observer(
+        tracing.metrics_observer(ops=ops, consensus=consensus)
+    )
+
+    privs, vset = make_validators(24)
+    block_id = make_block_id()
+    height, round_ = 5, 1
+    commit = make_commit(block_id, height, round_, vset, privs)
+    validation.verify_commit(CHAIN_ID, vset, block_id, height, commit)
+    # second pass: the digest-keyed result cache answers every lane
+    validation.verify_commit(CHAIN_ID, vset, block_id, height, commit)
+
+    events = _complete_events(ring.export())
+    by_name = {}
+    for ev in events:
+        by_name.setdefault(ev["name"], []).append(ev)
+
+    # consensus span tagged with height/round
+    vc = by_name["verify_commit"]
+    assert len(vc) == 2
+    for ev in vc:
+        assert ev["args"]["height"] == height
+        assert ev["args"]["round"] == round_
+        assert ev["args"]["sigs"] == 24
+
+    # nested under it: the engine batch, then the cache lookup
+    assert all(
+        ev["args"]["parent"] == "verify_commit"
+        for ev in by_name["verify_batch"]
+    )
+    lookups = by_name["cache_lookup"]
+    assert len(lookups) == 2
+    assert all(ev["args"]["parent"] == "verify_batch" for ev in lookups)
+    assert lookups[0]["args"]["hits"] == 0
+    assert lookups[1]["args"]["hits"] == 24  # warm pass: all cached
+
+    # per-chunk device stages ran only on the cold pass
+    assert len(by_name["prep_chunk"]) >= 1
+    for ev in by_name["prep_chunk"]:
+        assert ev["args"]["stage"] == "prep"
+        assert ev["args"]["engine"] == "ed25519"
+        assert ev["args"]["parent"] == "verify_batch"
+    dispatched = "dispatch_chunk" in by_name
+    fell_back = "host_fallback" in by_name
+    assert dispatched or fell_back  # every lane was answered somewhere
+
+    # the histograms observed exactly the spans the trace recorded:
+    # one clock, one count
+    assert _histogram_counts(ops) == _stage_counts_from_events(events)
+
+    ring.set_metrics_observer(None)
+
+
+def test_scheduler_spans_nest_assembly_and_flush(ring):
+    from tendermint_tpu.crypto.keys import Ed25519PrivKey
+    from tendermint_tpu.crypto.scheduler import VerifyScheduler
+    from tendermint_tpu.ops import ed25519_batch
+
+    priv = Ed25519PrivKey.from_seed(b"\x07" * 32)
+    pk = priv.pub_key().bytes()
+    msg = b"sched-traced"
+    sig = priv.sign(msg)
+    sched = VerifyScheduler(ed25519_batch.verify_batch, max_delay=0.01)
+    sched.start()
+    try:
+        assert sched.verify(pk, msg, sig)
+    finally:
+        sched.stop()
+    events = _complete_events(ring.export())
+    names = [e["name"] for e in events]
+    assert "sched_assemble" in names
+    assert "sched_flush" in names
+    flush = next(e for e in events if e["name"] == "sched_flush")
+    assert flush["args"]["lanes"] == 1
+    # the engine's own spans nest under the scheduler flush
+    vb = next(e for e in events if e["name"] == "verify_batch")
+    assert vb["args"]["parent"] == "sched_flush"
+
+
+def test_tracing_off_changes_no_verify_results(monkeypatch):
+    from tendermint_tpu.types import validation
+
+    privs, vset = make_validators(8)
+    block_id = make_block_id(b"off-mode")
+    commit = make_commit(block_id, 3, 0, vset, privs)
+
+    tracing.tracer.set_metrics_observer(None)
+    monkeypatch.setenv("TENDERMINT_TPU_TRACE", "off")
+    tracing.configure("off")
+    tracing.tracer.clear()
+    validation.verify_commit(CHAIN_ID, vset, block_id, 3, commit)  # no raise
+    assert len(tracing.tracer) == 0
+
+    tracing.configure("ring")
+    try:
+        validation.verify_commit(CHAIN_ID, vset, block_id, 3, commit)
+        assert len(tracing.tracer) > 0
+    finally:
+        tracing.configure("off")
+        tracing.tracer.clear()
+
+
+def test_file_mode_flush_writes_chrome_trace(tmp_path):
+    path = tmp_path / "trace.json"
+    tracing.configure(str(path))
+    try:
+        with tracing.span("flushed", k="v"):
+            pass
+        written = tracing.tracer.flush()
+        assert written == str(path)
+        doc = json.loads(path.read_text())
+        assert any(
+            e.get("name") == "flushed" for e in doc["traceEvents"]
+        )
+    finally:
+        tracing.configure("off")
+        tracing.tracer.clear()
