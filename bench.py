@@ -45,6 +45,10 @@ LIGHT_HEADERS = int(os.environ.get("BENCH_LIGHT_HEADERS", "16"))
 LIGHT_VALS = int(os.environ.get("BENCH_LIGHT_VALS", "1000"))
 SYNC_BLOCKS = int(os.environ.get("BENCH_SYNC_BLOCKS", "32"))
 SYNC_VALS = int(os.environ.get("BENCH_SYNC_VALS", "500"))
+# verifyd wire-vs-inproc comparison (in-process daemon, localhost wire)
+VERIFYD_CLIENTS = int(os.environ.get("BENCH_VERIFYD_CLIENTS", "4"))
+VERIFYD_LANES = int(os.environ.get("BENCH_VERIFYD_LANES", "64"))
+VERIFYD_ROUNDS = int(os.environ.get("BENCH_VERIFYD_ROUNDS", "8"))
 
 
 def _log_probe(line: str) -> None:
@@ -344,6 +348,101 @@ def _cache_amortization():
     }
 
 
+def _verifyd_wire_stats():
+    """Verification-as-a-service cost: an in-process verifyd daemon
+    serves VERIFYD_CLIENTS concurrent clients over the localhost wire,
+    each streaming VERIFYD_LANES-lane batches for VERIFYD_ROUNDS
+    rounds; the identical batch runs through the tiered dispatch
+    directly for the wire-overhead comparison. Batch occupancy and
+    cross-client flush counts come from the daemon's shared scheduler,
+    so they report the coalescing actually achieved, not the configured
+    ceiling."""
+    import threading
+
+    import numpy as np
+
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.verifyd import protocol
+    from tendermint_tpu.verifyd.client import VerifydClient
+    from tendermint_tpu.verifyd.server import VerifydServer
+
+    rng = np.random.default_rng(99)
+    pks, msgs, sigs = _make_workload(rng, VERIFYD_LANES)
+
+    # direct in-process dispatch of the same batch (warmed)
+    crypto_batch.tiered_verify_ed25519(pks, msgs, sigs)
+    t0 = time.perf_counter()
+    for _ in range(VERIFYD_ROUNDS):
+        crypto_batch.tiered_verify_ed25519(pks, msgs, sigs)
+    inproc_s = (time.perf_counter() - t0) / VERIFYD_ROUNDS
+
+    srv = VerifydServer(
+        max_batch=VERIFYD_LANES * VERIFYD_CLIENTS, max_delay=0.002
+    )
+    srv.start()
+    host, port = srv.address
+    lat = []
+    lat_mtx = threading.Lock()
+    errors = []
+
+    def run_client(i):
+        try:
+            c = VerifydClient(f"{host}:{port}", fallback=False)
+            for _ in range(VERIFYD_ROUNDS):
+                t = time.perf_counter()
+                oks = c.verify(
+                    pks, msgs, sigs, klass=protocol.CLASS_CONSENSUS
+                )
+                dt = time.perf_counter() - t
+                if not all(oks):
+                    raise AssertionError("verifyd rejected valid lanes")
+                with lat_mtx:
+                    lat.append(dt)
+            c.close()
+        except Exception as exc:
+            errors.append(repr(exc))
+
+    try:
+        warm = VerifydClient(f"{host}:{port}")
+        warm.verify(pks, msgs, sigs)
+        warm.close()
+        threads = [
+            threading.Thread(target=run_client, args=(i,))
+            for i in range(VERIFYD_CLIENTS)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors or not lat:
+            return {"error": errors[:3] or ["no samples"]}
+        sched = srv.scheduler
+        lat.sort()
+        total_lanes = len(lat) * VERIFYD_LANES
+        return {
+            "clients": VERIFYD_CLIENTS,
+            "lanes_per_call": VERIFYD_LANES,
+            "wire_sigs_per_s": round(total_lanes / wall, 1),
+            "wire_p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+            "wire_p95_ms": round(lat[int(len(lat) * 0.95)] * 1e3, 2),
+            "inproc_batch_ms": round(inproc_s * 1e3, 2),
+            "wire_overhead_x": round(
+                (sum(lat) / len(lat)) / inproc_s, 2
+            )
+            if inproc_s > 0
+            else None,
+            "flushes": sched.flushes,
+            "mean_batch_occupancy": round(
+                sched.entries_verified / max(1, sched.flushes), 1
+            ),
+            "cross_client_flushes": dict(srv.cross_client_flushes),
+        }
+    finally:
+        srv.stop()
+
+
 def child_main() -> None:
     import numpy as np
     import jax
@@ -394,6 +493,9 @@ def child_main() -> None:
         sync_bps = _blocksync_blocks_per_s(SYNC_BLOCKS, SYNC_VALS)
     if os.environ.get("BENCH_SKIP_CACHE") != "1":
         cache_stats = _cache_amortization()
+    verifyd_stats = None
+    if os.environ.get("BENCH_SKIP_VERIFYD") != "1":
+        verifyd_stats = _verifyd_wire_stats()
 
     print(
         json.dumps(
@@ -410,6 +512,7 @@ def child_main() -> None:
                 f"light_client_headers_per_s_v{LIGHT_VALS}": light_hps,
                 f"blocksync_blocks_per_s_v{SYNC_VALS}": sync_bps,
                 "cache": cache_stats,
+                "verifyd": verifyd_stats,
             }
         ),
         flush=True,

@@ -8,8 +8,15 @@ never-referenced instrument is dead weight on every /metrics scrape and
 usually means an instrumentation seam silently fell off in a refactor —
 this script makes that a CI failure instead of a dashboard mystery.
 
+A second pass audits exposition-name hygiene: every instrument's full
+name must resolve statically (the ``_name(s, "...")`` convention with a
+literal ``s = "<subsystem>"`` per class), match ``tendermint_[a-z0-9_]+``,
+and be globally unique — so a new subsystem (e.g. verifyd) cannot
+silently collide with or misname an existing series.
+
 Usage: python scripts/check_metrics.py  (exit 0 clean, 1 on dead
-instruments; also asserted by tests/test_metrics.py).
+instruments or name-hygiene violations; also asserted by
+tests/test_metrics.py and run by scripts/ci_checks.sh).
 """
 
 from __future__ import annotations
@@ -77,6 +84,87 @@ def referenced_attrs(root: str = PACKAGE, skip: str = METRICS_PY) -> set:
     return refs
 
 
+def declared_names(path: str = METRICS_PY) -> dict:
+    """Map full exposition name -> (class, lineno) for every instrument,
+    resolving the ``_name(s, "...")`` convention: each metrics class
+    assigns ``s = "<subsystem>"`` once and every factory call must pass
+    ``_name(s, "<literal>")`` so the full name is statically known."""
+    import re
+
+    with open(path, "r") as fh:
+        src = fh.read()
+    tree = ast.parse(src, filename=path)
+    namespace = "tendermint"
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "NAMESPACE"
+            and isinstance(node.value, ast.Constant)
+        ):
+            namespace = node.value.value
+    problems = []
+    names = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        subsystem = None
+        for node in ast.walk(cls):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "s"
+                and isinstance(node.value, ast.Constant)
+            ):
+                subsystem = node.value.value
+        for node in ast.walk(cls):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _FACTORIES
+                and node.args
+            ):
+                continue
+            arg = node.args[0]
+            full = None
+            if (
+                isinstance(arg, ast.Call)
+                and isinstance(arg.func, ast.Name)
+                and arg.func.id == "_name"
+                and len(arg.args) == 2
+                and isinstance(arg.args[1], ast.Constant)
+            ):
+                if subsystem is None:
+                    problems.append(
+                        f"{cls.name}:{node.lineno}: _name(s, ...) without a"
+                        f" literal `s = \"...\"` subsystem assignment"
+                    )
+                    continue
+                full = f"{namespace}_{subsystem}_{arg.args[1].value}"
+            elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                full = arg.value
+            else:
+                problems.append(
+                    f"{cls.name}:{node.lineno}: instrument name is not a"
+                    f" static _name(s, \"...\") or string literal"
+                )
+                continue
+            if not re.fullmatch(r"tendermint_[a-z0-9_]+", full):
+                problems.append(
+                    f"{cls.name}:{node.lineno}: bad metric name {full!r}"
+                )
+            if full in names:
+                other = names[full]
+                problems.append(
+                    f"{cls.name}:{node.lineno}: duplicate metric name"
+                    f" {full!r} (also declared at {other[0]}:{other[1]})"
+                )
+            names[full] = (cls.name, node.lineno)
+    return {"names": names, "problems": problems}
+
+
 def find_dead_instruments() -> list:
     decls = declared_instruments()
     refs = referenced_attrs()
@@ -90,6 +178,7 @@ def find_dead_instruments() -> list:
 def main() -> int:
     decls = declared_instruments()
     dead = find_dead_instruments()
+    rc = 0
     if dead:
         for name, cls, lineno in dead:
             print(
@@ -98,9 +187,18 @@ def main() -> int:
                 f"referenced under tendermint_tpu/",
                 file=sys.stderr,
             )
-        return 1
-    print(f"ok: all {len(decls)} declared instruments are referenced")
-    return 0
+        rc = 1
+    hygiene = declared_names()
+    for problem in hygiene["problems"]:
+        print(f"METRIC NAME {problem}", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(
+            f"ok: all {len(decls)} declared instruments are referenced;"
+            f" {len(hygiene['names'])} exposition names unique and"
+            f" well-formed"
+        )
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Repo CI gate: byte-compile, static metrics audit, tier-1 tests.
+#
+# The tier-1 line is the ROADMAP.md "Tier-1 verify" command verbatim —
+# keep the two in sync. DOTS_PASSED is the per-test pass count the
+# driver compares against the seed.
+set -u
+
+rc_total=0
+
+echo "== compileall =="
+python -m compileall -q tendermint_tpu tests scripts bench.py || rc_total=1
+
+echo "== check_metrics =="
+python scripts/check_metrics.py || rc_total=1
+
+echo "== tier-1 pytest =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+[ "$rc" -ne 0 ] && rc_total=1
+
+exit $rc_total

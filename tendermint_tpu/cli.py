@@ -12,6 +12,7 @@ The cmd/tendermint analog (main.go:29-61). Commands:
   inspect         chain state of a STOPPED node (JSON, or --serve RPC)
   replay          re-sync the ABCI app from the block store (Handshaker)
   light           light-client RPC proxy verified from a trust anchor
+  verifyd         run the shared verification daemon (owns the device)
   debug dump      diagnostic tarball from a RUNNING node
   wal2json        decode a consensus WAL to JSON records
   abci            drive an ABCI socket app (info/echo/query/check-tx)
@@ -507,6 +508,61 @@ def cmd_light(args) -> int:
     return 0
 
 
+def cmd_verifyd(args) -> int:
+    """Run the standalone verification service (verifyd/server.py): one
+    resident accelerator serving batched signature verification to many
+    nodes/light clients. ``--metrics HOST:PORT`` additionally serves the
+    Prometheus registry (and /debug/traces) over HTTP."""
+    from tendermint_tpu.libs.metrics import Registry, VerifydMetrics
+    from tendermint_tpu.verifyd.server import VerifydServer
+
+    if args.trace:
+        from tendermint_tpu.libs import tracing
+
+        tracing.configure(args.trace)
+    host, _, port = args.listen.rpartition(":")
+    reg = Registry()
+    server = VerifydServer(
+        host=host or "127.0.0.1",
+        port=int(port),
+        max_batch=args.max_batch,
+        max_delay=args.max_delay,
+        admission_cap=args.admission_cap,
+        max_pending=args.max_pending,
+        metrics=VerifydMetrics(reg),
+    )
+    metrics_server = None
+    if args.metrics:
+        from tendermint_tpu.rpc.server import RPCServer
+
+        mhost, _, mport = args.metrics.rpartition(":")
+        metrics_server = RPCServer(
+            {}, host=mhost or "127.0.0.1", port=int(mport),
+            metrics_registry=reg,
+        )
+    stop = []
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    server.start()
+    if metrics_server is not None:
+        metrics_server.start()
+    shost, sport = server.address
+    print(
+        f"verifyd serving on {shost}:{sport} "
+        f"(max_batch={args.max_batch}, max_delay={args.max_delay}s, "
+        f"admission_cap={args.admission_cap})",
+        flush=True,
+    )
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        if metrics_server is not None:
+            metrics_server.stop()
+        server.stop()
+    return 0
+
+
 def cmd_debug_dump(args) -> int:
     """commands/debug/dump.go: collect a diagnostic bundle from a RUNNING
     node — status, consensus dump, net info, metrics — plus the home's
@@ -924,6 +980,39 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--laddr", default="127.0.0.1:0")
     p.add_argument("--sequential", action="store_true")
     p.set_defaults(fn=cmd_light)
+
+    p = sub.add_parser(
+        "verifyd", help="run the shared verification daemon"
+    )
+    p.add_argument(
+        "--listen", default="127.0.0.1:26670", metavar="HOST:PORT",
+        help="gRPC listen address",
+    )
+    p.add_argument(
+        "--max-batch", type=int, default=256,
+        help="flush when this many lanes are pending",
+    )
+    p.add_argument(
+        "--max-delay", type=float, default=0.002,
+        help="max seconds the oldest lane waits before a flush",
+    )
+    p.add_argument(
+        "--admission-cap", type=int, default=1024,
+        help="pending-lane ceiling before light/rpc load is shed",
+    )
+    p.add_argument(
+        "--max-pending", type=int, default=4096,
+        help="hard pending-lane cap for ALL classes",
+    )
+    p.add_argument(
+        "--metrics", default="", metavar="HOST:PORT",
+        help="serve /metrics (and /debug/traces) here",
+    )
+    p.add_argument(
+        "--trace", default="",
+        help="span tracing: off | ring | <chrome-trace path>",
+    )
+    p.set_defaults(fn=cmd_verifyd)
 
     p = sub.add_parser(
         "debug", help="collect diagnostics from a running node"

@@ -103,6 +103,18 @@ class ConsensusConfig:
 
 
 @dataclass
+class OpsConfig:
+    """Accelerator operations knobs (no reference analog — the
+    reference has no device boundary)."""
+
+    # "host:port" of a verifyd verification daemon: device-worthy
+    # signature batches are verified over the wire instead of on a
+    # local accelerator. Empty = local verification. The
+    # TENDERMINT_TPU_VERIFY_REMOTE env var applies when this is empty.
+    verify_remote: str = ""
+
+
+@dataclass
 class IndexerConfig:
     enabled: bool = True
     # Event sinks: kv | null | sql (reference indexer sink list,
@@ -124,6 +136,7 @@ class Config:
     )
     consensus: ConsensusConfig = dc_field(default_factory=ConsensusConfig)
     indexer: IndexerConfig = dc_field(default_factory=IndexerConfig)
+    ops: OpsConfig = dc_field(default_factory=OpsConfig)
 
     # --- derived paths ------------------------------------------------------
 
@@ -175,13 +188,14 @@ class Config:
             p2p_queue_type=self.p2p.queue_type,
             double_sign_check_height=self.consensus.double_sign_check_height,
             trace=self.base.trace,
+            verify_remote=self.ops.verify_remote,
         )
 
     # --- TOML ---------------------------------------------------------------
 
     _SECTIONS = (
         "base", "p2p", "rpc", "mempool", "statesync", "privval",
-        "consensus", "indexer",
+        "consensus", "indexer", "ops",
     )
 
     def to_toml(self) -> str:

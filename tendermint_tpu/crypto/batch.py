@@ -25,6 +25,40 @@ from tendermint_tpu.crypto.keys import (
 DEVICE_THRESHOLD = 16
 
 
+def remote_verify_backend():
+    """The verifyd remote backend's ``verify_fn`` when one is configured
+    (``TENDERMINT_TPU_VERIFY_REMOTE`` / ``[ops] verify_remote``), else
+    None. Lazy import keeps crypto importable without the service."""
+    try:
+        from tendermint_tpu.verifyd import client as vclient
+    except ImportError:
+        return None
+    try:
+        return vclient.remote_backend()
+    except Exception:
+        return None
+
+
+def host_verify_ed25519(pks, msgs, sigs) -> List[bool]:
+    """Host ZIP-215 oracle over raw lanes — the universal fallback."""
+    from tendermint_tpu.crypto.ed25519_ref import verify_zip215
+
+    return [verify_zip215(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+
+
+def tiered_verify_ed25519(pks, msgs, sigs) -> List[bool]:
+    """The small-batch policy shared by Ed25519BatchVerifier, the
+    process-wide scheduler, and verifyd's default flush target: below
+    the device threshold a launch costs more than it saves — at
+    steady-state vote rates flushes are 1-2 entries and must stay on
+    the host; only floods hit the device."""
+    if len(pks) < DEVICE_THRESHOLD:
+        return host_verify_ed25519(pks, msgs, sigs)
+    from tendermint_tpu.ops import verify_batch
+
+    return list(verify_batch(pks, msgs, sigs))
+
+
 def note_validator_set(vals) -> None:
     """Register the active validator set with the device precompute
     cache (ops/precompute.py): its ed25519 keys become eligible for
@@ -98,6 +132,14 @@ class Ed25519BatchVerifier(BatchVerifier):
         if use_device is None:
             use_device = n >= self.device_threshold
         if use_device:
+            # A configured verifyd remote owns the accelerator for this
+            # process: ship device-worthy batches to it (it amortizes
+            # across clients; its client falls back to host verify on
+            # transport failure, so verdicts never hang on the wire).
+            remote = remote_verify_backend()
+            if remote is not None:
+                oks = remote(self._pks, self._msgs, self._sigs)
+                return all(oks), list(oks)
             try:
                 from tendermint_tpu.ops import verify_batch
             except ImportError:  # device engine unavailable: fail safe to host
@@ -105,12 +147,7 @@ class Ed25519BatchVerifier(BatchVerifier):
             else:
                 oks = verify_batch(self._pks, self._msgs, self._sigs)
         if not use_device:
-            from tendermint_tpu.crypto.ed25519_ref import verify_zip215
-
-            oks = [
-                verify_zip215(pk, m, s)
-                for pk, m, s in zip(self._pks, self._msgs, self._sigs)
-            ]
+            oks = host_verify_ed25519(self._pks, self._msgs, self._sigs)
         return all(oks), list(oks)
 
 
@@ -189,32 +226,20 @@ def get_shared_scheduler():
             from tendermint_tpu.crypto.scheduler import VerifyScheduler
 
             def _verify(pks, msgs, sigs):
-                # Same small-batch policy as Ed25519BatchVerifier: below
-                # the device threshold a launch costs more than it saves
-                # — at steady-state vote rates flushes are 1-2 entries
-                # and must stay on the host; only floods hit the device.
-                if len(pks) < DEVICE_THRESHOLD:
-                    from tendermint_tpu.crypto.ed25519_ref import verify_zip215
-
-                    return [
-                        verify_zip215(p, m, s)
-                        for p, m, s in zip(pks, msgs, sigs)
-                    ]
-                from tendermint_tpu.ops import verify_batch
-
-                return verify_batch(pks, msgs, sigs)
+                # A configured verifyd remote gets every flush — even
+                # tiny ones: the whole point of the service is that
+                # OTHER clients' lanes are coalescing there too.
+                remote = remote_verify_backend()
+                if remote is not None:
+                    return remote(pks, msgs, sigs)
+                return tiered_verify_ed25519(pks, msgs, sigs)
 
             def _host_fallback(pks, msgs, sigs):
                 # verify_batch already degrades per-chunk via the device
                 # health machine; this catches failures outside it (e.g.
                 # engine import errors) so a flush never fails closed
                 # when the host oracle can still answer it.
-                from tendermint_tpu.crypto.ed25519_ref import verify_zip215
-
-                return [
-                    verify_zip215(p, m, s)
-                    for p, m, s in zip(pks, msgs, sigs)
-                ]
+                return host_verify_ed25519(pks, msgs, sigs)
 
             _shared_scheduler = VerifyScheduler(
                 _verify, fallback_fn=_host_fallback

@@ -16,6 +16,22 @@ Per-entry verdicts come from the batch verifier's attribution (the
 reference's BatchVerifier.Verify bool slice, crypto/crypto.go:58-76), so
 one bad signature fails only its own future.
 
+Serving extensions (used by verifyd, available to any caller):
+
+- per-entry ``priority`` — when more work is pending than one batch
+  holds, the dequeue is priority-ordered (lower value first, FIFO
+  within a class) so consensus lanes never queue behind rpc floods;
+- per-entry ``flush_by`` — an absolute monotonic deadline that pulls
+  the flush earlier than ``max_delay`` when a wire deadline would
+  otherwise expire while the lane sits in the accumulator;
+- ``max_pending`` backpressure — ``submit`` raises
+  ``SchedulerSaturatedError`` past the cap instead of growing the
+  queue unboundedly (callers surface this as RESOURCE_EXHAUSTED);
+- ``flush_reasons`` counters (``size``/``deadline``/``shutdown``) and
+  an ``on_flush(reason, batch, seconds)`` callback, invoked BEFORE the
+  futures resolve so observers see the flush strictly-before any
+  waiter wakes.
+
 Wiring: callers that ingest signatures from many concurrent sources
 (per-peer vote floods, RPC broadcast storms) submit here instead of
 calling ``pub_key.verify_signature`` inline; the single-threaded
@@ -36,6 +52,10 @@ DEFAULT_MAX_BATCH = 256
 DEFAULT_MAX_DELAY = 0.002  # 2ms: well under a vote round-trip
 
 
+class SchedulerSaturatedError(RuntimeError):
+    """Pending queue is at ``max_pending``; shed load explicitly."""
+
+
 @dataclass
 class _Pending:
     pubkey: bytes
@@ -44,6 +64,16 @@ class _Pending:
     submitted: float
     done: threading.Event = field(default_factory=threading.Event)
     ok: bool = False
+    priority: int = 0  # lower flushes first when over-subscribed
+    flush_by: Optional[float] = None  # absolute monotonic wire deadline
+    tag: Optional[object] = None  # submitter identity (e.g. connection)
+
+    def due(self, max_delay: float) -> float:
+        """Absolute monotonic time this entry must be flushed by."""
+        due = self.submitted + max_delay
+        if self.flush_by is not None and self.flush_by < due:
+            due = self.flush_by
+        return due
 
 
 class VerifyScheduler:
@@ -70,11 +100,20 @@ class VerifyScheduler:
                 [Sequence[bytes], Sequence[bytes], Sequence[bytes]], List[bool]
             ]
         ] = None,
+        max_pending: int = 0,
+        on_flush: Optional[
+            Callable[[str, List[_Pending], float], None]
+        ] = None,
     ):
         self._verify_fn = verify_fn
         self._fallback_fn = fallback_fn
         self.max_batch = max_batch
         self.max_delay = max_delay
+        # 0 = unbounded (the historical in-process behavior); a serving
+        # front-end sets a cap and maps SchedulerSaturatedError to an
+        # explicit wire rejection.
+        self.max_pending = max_pending
+        self._on_flush = on_flush
         self._pending: List[_Pending] = []
         self._mtx = threading.Lock()
         self._wake = threading.Condition(self._mtx)
@@ -86,6 +125,8 @@ class VerifyScheduler:
         self.entries_coalesced = 0  # duplicate submissions answered by one lane
         self.flush_errors = 0  # primary verify_fn raised
         self.fallback_flushes = 0  # fallback_fn answered a failed flush
+        self.submit_rejections = 0  # submits refused by max_pending
+        self.flush_reasons = {"size": 0, "deadline": 0, "shutdown": 0}
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -110,23 +151,53 @@ class VerifyScheduler:
         # fail any stragglers closed rather than hanging their callers
         with self._mtx:
             leftovers, self._pending = self._pending, []
+        if leftovers:
+            self.flush_reasons["shutdown"] += 1
+            self._notify_flush("shutdown", leftovers, 0.0)
         for p in leftovers:
             p.ok = False
             p.done.set()
 
     # --- submission ----------------------------------------------------------
 
-    def submit(self, pubkey: bytes, msg: bytes, sig: bytes) -> _Pending:
+    def submit(
+        self,
+        pubkey: bytes,
+        msg: bytes,
+        sig: bytes,
+        *,
+        priority: int = 0,
+        flush_by: Optional[float] = None,
+        tag: Optional[object] = None,
+    ) -> _Pending:
         """Enqueue one signature; returns a handle for ``wait``. Callers
         with several signatures submit all first so one flush covers
         them, instead of paying the deadline once per signature."""
-        entry = _Pending(pubkey, msg, sig, time.monotonic())
+        entry = _Pending(
+            pubkey,
+            msg,
+            sig,
+            time.monotonic(),
+            priority=priority,
+            flush_by=flush_by,
+            tag=tag,
+        )
         with self._wake:
             if self._stop or self._thread is None:
                 raise RuntimeError("scheduler not running")
+            if self.max_pending and len(self._pending) >= self.max_pending:
+                self.submit_rejections += 1
+                raise SchedulerSaturatedError(
+                    f"verify queue full ({self.max_pending} pending)"
+                )
             self._pending.append(entry)
             self._wake.notify_all()
         return entry
+
+    def pending_depth(self) -> int:
+        """Entries accumulated but not yet handed to a flush."""
+        with self._mtx:
+            return len(self._pending)
 
     def wait(self, entry: _Pending, timeout: float = 10.0) -> bool:
         """Block until the entry's batch flushed; False on timeout (fail
@@ -143,26 +214,53 @@ class VerifyScheduler:
 
     # --- accumulator ---------------------------------------------------------
 
+    def _notify_flush(
+        self, reason: str, batch: List[_Pending], seconds: float
+    ) -> None:
+        if self._on_flush is None:
+            return
+        try:
+            self._on_flush(reason, batch, seconds)
+        except Exception:
+            pass  # observers never break the drain loop
+
     def _run(self) -> None:
         while True:
+            reason = "size"
             with self._wake:
                 while not self._stop:
                     if len(self._pending) >= self.max_batch:
+                        reason = "size"
                         break
                     if self._pending:
-                        oldest = self._pending[0].submitted
-                        wait = self.max_delay - (time.monotonic() - oldest)
+                        # earliest obligation across max_delay AND any
+                        # per-entry wire deadline (flush_by)
+                        due = min(
+                            p.due(self.max_delay) for p in self._pending
+                        )
+                        wait = due - time.monotonic()
                         if wait <= 0:
+                            reason = "deadline"
                             break
                         self._wake.wait(timeout=wait)
                     else:
                         self._wake.wait(timeout=0.1)
                 if self._stop:
                     return
-                batch, self._pending = (
-                    self._pending[: self.max_batch],
-                    self._pending[self.max_batch :],
-                )
+                if len(self._pending) > self.max_batch:
+                    # over-subscribed: highest-priority (lowest value)
+                    # lanes flush first, FIFO within a class
+                    order = sorted(
+                        self._pending,
+                        key=lambda p: (p.priority, p.submitted),
+                    )
+                    batch = order[: self.max_batch]
+                    taken = {id(p) for p in batch}
+                    self._pending = [
+                        p for p in self._pending if id(p) not in taken
+                    ]
+                else:
+                    batch, self._pending = self._pending, []
             if not batch:
                 continue
             # Coalesce duplicate (pubkey, msg, sig) submissions: a vote
@@ -186,7 +284,8 @@ class VerifyScheduler:
                     slots.append(idx)
                 asp.set(unique=len(pks), coalesced=len(batch) - len(pks))
             self.entries_coalesced += len(batch) - len(pks)
-            with tracing.span("sched_flush", lanes=len(pks)):
+            t0 = time.monotonic()
+            with tracing.span("sched_flush", lanes=len(pks), reason=reason):
                 try:
                     oks = self._verify_fn(pks, msgs, sigs)
                 except Exception:
@@ -204,7 +303,11 @@ class VerifyScheduler:
             if len(oks) != len(pks):  # misbehaving verifier: fail closed
                 oks = [False] * len(pks)
             self.flushes += 1
+            self.flush_reasons[reason] += 1
             self.entries_verified += len(batch)
+            # observers run strictly-before the futures resolve, so a
+            # waiter that wakes can already see its flush accounted for
+            self._notify_flush(reason, batch, time.monotonic() - t0)
             for p, idx in zip(batch, slots):
                 p.ok = bool(oks[idx])
                 p.done.set()

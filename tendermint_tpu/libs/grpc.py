@@ -43,6 +43,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 # --- frame types / flags ----------------------------------------------------
@@ -648,10 +649,19 @@ class GrpcServer:
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
-            try:
-                conn_sock, _ = self._lsock.accept()
-            except OSError:
+            lsock = self._lsock
+            if lsock is None:
                 return
+            try:
+                conn_sock, _ = lsock.accept()
+            except OSError:
+                # Transient accept errors (ECONNABORTED: the client tore
+                # the connection off mid-handshake) must not kill the
+                # accept loop — only a closed listener / stop() ends it.
+                if self._stop.is_set() or self._lsock is None:
+                    return
+                time.sleep(0.02)
+                continue
             # prune finished connection threads so the list stays bounded
             self._threads = [t for t in self._threads if t.is_alive()]
             t = threading.Thread(

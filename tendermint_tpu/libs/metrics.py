@@ -465,6 +465,57 @@ class OpsMetrics(_NopMixin):
         )
 
 
+class VerifydMetrics(_NopMixin):
+    """The verifyd verification service (verifyd/server.py): shared-
+    scheduler serving metrics — queue depth and sheds by priority
+    class, batch occupancy, flush reasons, wire latency. No reference
+    analog; the shape follows inference-serving practice."""
+
+    def __init__(self, reg: Optional[Registry]):
+        reg = reg or Registry()
+        s = "verifyd"
+        self.queue_depth = reg.gauge(
+            _name(s, "queue_depth"),
+            "Lanes pending in the shared scheduler, by priority class.",
+            labels=("klass",),
+        )
+        self.admission_rejections = reg.counter(
+            _name(s, "admission_rejections_total"),
+            "Requests shed by the admission controller.",
+            labels=("klass", "reason"),
+        )
+        self.requests = reg.counter(
+            _name(s, "requests_total"),
+            "Wire requests served, by request kind and response status.",
+            labels=("kind", "status"),
+        )
+        self.lanes = reg.counter(
+            _name(s, "lanes_total"),
+            "Signature lanes accepted into the scheduler, by class.",
+            labels=("klass",),
+        )
+        self.request_seconds = reg.histogram(
+            _name(s, "request_seconds"),
+            "Wire latency per request (decode to respond), seconds.",
+            labels=("kind",),
+        )
+        self.batch_occupancy = reg.histogram(
+            _name(s, "batch_occupancy"),
+            "Lanes per scheduler flush (cross-client batch size).",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        )
+        self.flushes = reg.counter(
+            _name(s, "flushes_total"),
+            "Scheduler flushes, by trigger reason (size/deadline/shutdown).",
+            labels=("reason",),
+        )
+        self.cross_client_flushes = reg.counter(
+            _name(s, "cross_client_flushes_total"),
+            "Flushes whose lanes came from more than one client connection.",
+            labels=("reason",),
+        )
+
+
 class StateMetrics(_NopMixin):
     """internal/state/metrics.gen.go."""
 

@@ -19,6 +19,8 @@ from tendermint_tpu.types.validation import (
     verify_commit_light_trusting,
 )
 from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.verifyd.client import classify as _classify
+from tendermint_tpu.verifyd.protocol import CLASS_LIGHT as _CLASS_LIGHT
 
 DEFAULT_TRUST_LEVEL = Fraction(1, 3)
 
@@ -113,24 +115,27 @@ def verify_non_adjacent(
     _verify_new_header_and_vals(
         untrusted_header, untrusted_vals, trusted_header, now, max_clock_drift
     )
-    try:
-        verify_commit_light_trusting(
-            trusted_header.chain_id, trusted_vals, untrusted_header.commit, trust_level
-        )
-    except NotEnoughVotingPowerError as e:
-        raise NewValSetCantBeTrustedError(str(e)) from e
-    except ValueError as e:
-        raise InvalidHeaderError(str(e)) from e
-    try:
-        verify_commit_light(
-            trusted_header.chain_id,
-            untrusted_vals,
-            untrusted_header.commit.block_id,
-            untrusted_header.height,
-            untrusted_header.commit,
-        )
-    except ValueError as e:
-        raise InvalidHeaderError(str(e)) from e
+    # Light-client workload class (outermost wins over validation's
+    # blocksync default): a verifyd remote may shed this under load.
+    with _classify(_CLASS_LIGHT):
+        try:
+            verify_commit_light_trusting(
+                trusted_header.chain_id, trusted_vals, untrusted_header.commit, trust_level
+            )
+        except NotEnoughVotingPowerError as e:
+            raise NewValSetCantBeTrustedError(str(e)) from e
+        except ValueError as e:
+            raise InvalidHeaderError(str(e)) from e
+        try:
+            verify_commit_light(
+                trusted_header.chain_id,
+                untrusted_vals,
+                untrusted_header.commit.block_id,
+                untrusted_header.height,
+                untrusted_header.commit,
+            )
+        except ValueError as e:
+            raise InvalidHeaderError(str(e)) from e
 
 
 def verify_adjacent(
@@ -155,16 +160,17 @@ def verify_adjacent(
         raise InvalidHeaderError(
             "expected old header's next validators to match those from new header"
         )
-    try:
-        verify_commit_light(
-            trusted_header.chain_id,
-            untrusted_vals,
-            untrusted_header.commit.block_id,
-            untrusted_header.height,
-            untrusted_header.commit,
-        )
-    except ValueError as e:
-        raise InvalidHeaderError(str(e)) from e
+    with _classify(_CLASS_LIGHT):
+        try:
+            verify_commit_light(
+                trusted_header.chain_id,
+                untrusted_vals,
+                untrusted_header.commit.block_id,
+                untrusted_header.height,
+                untrusted_header.commit,
+            )
+        except ValueError as e:
+            raise InvalidHeaderError(str(e)) from e
 
 
 def verify(

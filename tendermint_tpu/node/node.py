@@ -93,6 +93,11 @@ class NodeConfig:
     # GET /debug/traces, any other value is a Chrome-trace JSON path
     # flushed at exit. "off" disables recording explicitly.
     trace: str = ""
+    # Remote verification service ([ops] verify_remote / the
+    # TENDERMINT_TPU_VERIFY_REMOTE env var): "host:port" routes
+    # device-worthy signature batches to a verifyd daemon instead of a
+    # local accelerator ("" = local verification).
+    verify_remote: str = ""
 
 
 class Node:
@@ -315,6 +320,14 @@ class Node:
                 ops=ops_metrics, consensus=consensus_metrics
             )
         )
+        # Remote verification backend (verifyd/client.py): a configured
+        # address makes every device-worthy batch go over the wire; the
+        # client keeps a local host-verify fallback, so a dead daemon
+        # degrades to CPU verification rather than failing commits.
+        if config.verify_remote:
+            from tendermint_tpu.verifyd import client as _vclient
+
+            _vclient.set_remote_addr(config.verify_remote)
 
         # --- pools + executor (node.go:258-297) ------------------------------
         self.mempool = TxMempool(

@@ -18,6 +18,12 @@ from tendermint_tpu.crypto import batch as crypto_batch
 from tendermint_tpu.libs import tracing
 from tendermint_tpu.types.block import BlockID, Commit, CommitSig, BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT
 from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.verifyd.client import classify as _classify
+from tendermint_tpu.verifyd.protocol import (
+    CLASS_BLOCKSYNC as _CLASS_BLOCKSYNC,
+    CLASS_CONSENSUS as _CLASS_CONSENSUS,
+    CLASS_LIGHT as _CLASS_LIGHT,
+)
 
 BATCH_VERIFY_THRESHOLD = 2  # validation.go:12
 
@@ -65,7 +71,9 @@ def verify_commit(
 ) -> None:
     """validation.go:28-54: +2/3 signed; checks ALL signatures (ABCI apps
     depend on the full LastCommitInfo for incentivization)."""
-    with tracing.span(
+    # Outermost-wins workload class: a configured verifyd remote treats
+    # full commit verification as consensus-priority (never shed).
+    with _classify(_CLASS_CONSENSUS), tracing.span(
         "verify_commit",
         height=height,
         round=commit.round,
@@ -90,7 +98,9 @@ def verify_commit_light(
     chain_id: str, vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
 ) -> None:
     """validation.go:58-87: light-client/blocksync variant; stops at +2/3."""
-    with tracing.span(
+    # Blocksync-priority by default; the light package classifies its
+    # own calls "light" first (outermost wins).
+    with _classify(_CLASS_BLOCKSYNC), tracing.span(
         "verify_commit",
         mode="light",
         height=height,
@@ -131,13 +141,17 @@ def verify_commit_light_trusting(
     voting_power_needed = total_mul // trust_level.denominator
     ignore = lambda c: c.block_id_flag != BLOCK_ID_FLAG_COMMIT
     count = lambda c: True
-    if _should_batch_verify(vals, commit):
-        return _verify_commit_batch(
-            chain_id, vals, commit, voting_power_needed, ignore, count, False, False
+    # Trusting verification only happens on the light-client path.
+    with _classify(_CLASS_LIGHT):
+        if _should_batch_verify(vals, commit):
+            return _verify_commit_batch(
+                chain_id, vals, commit, voting_power_needed, ignore, count,
+                False, False,
+            )
+        return _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            False, False,
         )
-    return _verify_commit_single(
-        chain_id, vals, commit, voting_power_needed, ignore, count, False, False
-    )
 
 
 def _verify_commit_batch(

@@ -1,0 +1,12 @@
+"""verifyd: standalone accelerator verification service.
+
+One resident device, many clients: nodes, light clients, and RPC
+front-ends send pk/msg/sig lanes over the wire; the daemon funnels every
+connection into one shared ``VerifyScheduler`` so batches form ACROSS
+clients — the same dynamic-batching/deadline/backpressure shape as an
+inference server, applied to Ed25519/sr25519 verification.
+
+- ``protocol`` — compact varint-framed request/response codec
+- ``server`` — the daemon (priority classes, deadlines, admission)
+- ``client`` — pooled client + remote-backend plumbing for the node
+"""

@@ -1,0 +1,343 @@
+"""verifyd client: pooled, retrying, deadline-propagating.
+
+``VerifydClient.verify`` is the wire analog of
+``ops.verify_batch(pks, msgs, sigs) -> List[bool]``, so it drops into
+every seam that takes a verify_fn: the shared ``VerifyScheduler``
+(crypto/batch.get_shared_scheduler), ``Ed25519BatchVerifier`` (and
+through it ``types/validation.verify_commit``), and ``light/verifier``.
+
+Failure semantics (fail AVAILABLE, not open): connection loss retries
+with exponential backoff across a small channel pool; a dead server,
+an admission rejection (RESOURCE_EXHAUSTED), or an expired deadline
+degrade to the local host oracle (``verify_zip215`` / sr25519 host
+verify) when ``fallback`` is enabled — verdicts stay sound because the
+host oracle is the same ZIP-215 ground truth the device kernels are
+tested against. With ``fallback=False`` the caller sees
+``VerifydRejectedError`` / ``VerifydUnavailableError`` instead.
+
+Selection: ``TENDERMINT_TPU_VERIFY_REMOTE=<host:port>`` env or the
+``[ops] verify_remote`` config key (plumbed via node assembly into
+``set_remote_addr``). ``remote_backend()`` returns the process-wide
+client's verify_fn, or None when no remote is configured.
+
+Workload classes ride a thread-local set by ``classify(klass)`` at the
+call sites that know the work's nature (consensus commit verification,
+blocksync, light-client header checks) — outermost wins, so the light
+package's "light" labeling is not overridden by validation internals.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, List, Optional, Sequence
+
+from tendermint_tpu.libs import tracing
+from tendermint_tpu.libs.grpc import GrpcChannel, GrpcError, H2ProtocolError
+from tendermint_tpu.verifyd import protocol
+from tendermint_tpu.verifyd.protocol import (
+    ALGO_ED25519,
+    ALGO_SR25519,
+    CLASS_BLOCKSYNC,
+    CLASS_CONSENSUS,
+    CLASS_LIGHT,
+    CLASS_RPC,
+    KIND_COMMIT,
+    KIND_HEADER,
+    KIND_RAW,
+    STATUS_NAMES,
+    STATUS_OK,
+    VERIFY_PATH,
+    VerifyRequest,
+)
+
+REMOTE_ENV = "TENDERMINT_TPU_VERIFY_REMOTE"
+
+# which request kind a class implies when the caller sets none
+_CLASS_KIND = {
+    CLASS_CONSENSUS: KIND_COMMIT,
+    CLASS_BLOCKSYNC: KIND_COMMIT,
+    CLASS_LIGHT: KIND_HEADER,
+    CLASS_RPC: KIND_RAW,
+}
+
+
+class VerifydUnavailableError(ConnectionError):
+    """Server unreachable after retries (and fallback disabled)."""
+
+
+class VerifydRejectedError(RuntimeError):
+    """Server answered non-OK (admission shed, expired deadline, ...)."""
+
+    def __init__(self, status: int, message: str = ""):
+        self.status = status
+        super().__init__(
+            f"verifyd {STATUS_NAMES.get(status, status)}: {message}"
+        )
+
+
+# --- workload classification (thread-local, outermost wins) ----------------
+
+_tls = threading.local()
+
+
+@contextmanager
+def classify(klass: int):
+    """Tag verification work on this thread with a priority class. The
+    OUTERMOST classification wins: light/verifier's "light" stays in
+    force through the validation internals it calls."""
+    if getattr(_tls, "klass", None) is not None:
+        yield
+        return
+    _tls.klass = klass
+    try:
+        yield
+    finally:
+        _tls.klass = None
+
+
+def current_class() -> Optional[int]:
+    return getattr(_tls, "klass", None)
+
+
+# --- the client -------------------------------------------------------------
+
+
+def _host_verify(algo: int, pks, msgs, sigs) -> List[bool]:
+    if algo == ALGO_SR25519:
+        from tendermint_tpu.crypto.sr25519 import verify as sr_verify
+
+        return [sr_verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+    from tendermint_tpu.crypto.ed25519_ref import verify_zip215
+
+    return [verify_zip215(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+
+
+class VerifydClient:
+    """Pooled blocking client for one verifyd server.
+
+    A small pool of HTTP/2 channels (each carries one call at a time)
+    lets concurrent caller threads overlap their wire round-trips —
+    which is exactly what gives the server cross-client batches.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        pool_size: int = 4,
+        timeout: float = 10.0,
+        retries: int = 3,
+        backoff: float = 0.05,
+        fallback: bool = True,
+    ):
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"verifyd address must be host:port, got {addr!r}")
+        self.addr = addr
+        self._host = host
+        self._port = int(port)
+        self.timeout = timeout
+        self.retries = retries
+        self.backoff = backoff
+        self.fallback = fallback
+        self._mtx = threading.Lock()
+        self._pool: List[GrpcChannel] = []
+        self._free: List[GrpcChannel] = []
+        self._pool_size = max(1, pool_size)
+        self._available = threading.Condition(self._mtx)
+        # observability
+        self.calls = 0
+        self.transport_retries = 0
+        self.fallback_calls = 0
+        self.rejected = {}  # status -> count
+
+    def _acquire(self) -> GrpcChannel:
+        with self._available:
+            while True:
+                if self._free:
+                    return self._free.pop()
+                if len(self._pool) < self._pool_size:
+                    ch = GrpcChannel(
+                        self._host, self._port, timeout=self.timeout
+                    )
+                    self._pool.append(ch)
+                    return ch
+                self._available.wait(timeout=self.timeout)
+
+    def _release(self, ch: GrpcChannel, broken: bool = False) -> None:
+        with self._available:
+            if broken:
+                self._pool.remove(ch)
+                try:
+                    ch.close()
+                except Exception:
+                    pass
+            else:
+                self._free.append(ch)
+            self._available.notify()
+
+    def close(self) -> None:
+        with self._available:
+            for ch in self._pool:
+                try:
+                    ch.close()
+                except Exception:
+                    pass
+            self._pool.clear()
+            self._free.clear()
+            self._available.notify_all()
+
+    # --- calls --------------------------------------------------------------
+
+    def call(
+        self, req: VerifyRequest, timeout: Optional[float] = None
+    ) -> protocol.VerifyResponse:
+        """Send one request, retrying with exponential backoff on
+        transport failure; raises VerifydUnavailableError when every
+        attempt failed. Server-side non-OK statuses return normally —
+        the caller decides whether to fall back or surface them."""
+        payload = protocol.encode_request(req)
+        timeout = self.timeout if timeout is None else timeout
+        delay = self.backoff
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            ch = self._acquire()
+            try:
+                raw = ch.unary(VERIFY_PATH, payload, timeout=timeout)
+            except GrpcError as exc:
+                # the server answered (wrong path, handler crash): not a
+                # transport problem, retrying the same call won't help
+                self._release(ch)
+                raise VerifydUnavailableError(
+                    f"verifyd {self.addr} errored: {exc}"
+                ) from exc
+            except (OSError, H2ProtocolError) as exc:
+                self._release(ch, broken=True)
+                last_exc = exc
+                if attempt < self.retries:
+                    self.transport_retries += 1
+                    time.sleep(delay)
+                    delay *= 2
+                    continue
+                raise VerifydUnavailableError(
+                    f"verifyd {self.addr} unreachable: {exc}"
+                ) from exc
+            else:
+                self._release(ch)
+                self.calls += 1
+                return protocol.decode_response(raw)
+        raise VerifydUnavailableError(
+            f"verifyd {self.addr} unreachable: {last_exc}"
+        )
+
+    def verify(
+        self,
+        pks: Sequence[bytes],
+        msgs: Sequence[bytes],
+        sigs: Sequence[bytes],
+        *,
+        algo: int = ALGO_ED25519,
+        klass: Optional[int] = None,
+        kind: Optional[int] = None,
+        deadline: Optional[float] = None,
+    ) -> List[bool]:
+        """Remote batch verify with local host fallback. The class
+        defaults to the thread's ``classify`` context (else rpc); the
+        deadline defaults to the client timeout and propagates on the
+        wire so the server can shed or flush-early accordingly."""
+        if not pks:
+            return []
+        if klass is None:
+            klass = current_class()
+            if klass is None:
+                klass = CLASS_RPC
+        if kind is None:
+            kind = _CLASS_KIND.get(klass, KIND_RAW)
+        if deadline is None:
+            deadline = self.timeout
+        req = VerifyRequest(
+            kind=kind,
+            klass=klass,
+            deadline_ms=max(1, int(deadline * 1000)),
+            algo=algo,
+            pks=list(pks),
+            msgs=list(msgs),
+            sigs=list(sigs),
+        )
+        with tracing.span(
+            "verifyd_call", lanes=len(req), klass=klass, algo=algo
+        ) as sp:
+            try:
+                # transport grace past the verify deadline: the server
+                # answers DEADLINE_EXCEEDED at exactly `deadline`; the
+                # wire timeout must not race that response
+                resp = self.call(req, timeout=deadline + 0.5)
+            except VerifydUnavailableError:
+                if not self.fallback:
+                    raise
+                sp.set(outcome="fallback_unavailable")
+                self.fallback_calls += 1
+                return _host_verify(algo, pks, msgs, sigs)
+            if resp.status != STATUS_OK or len(resp.verdicts) != len(pks):
+                self.rejected[resp.status] = (
+                    self.rejected.get(resp.status, 0) + 1
+                )
+                if not self.fallback:
+                    raise VerifydRejectedError(resp.status, resp.message)
+                sp.set(outcome=STATUS_NAMES.get(resp.status, "bad"))
+                self.fallback_calls += 1
+                return _host_verify(algo, pks, msgs, sigs)
+            sp.set(outcome="ok")
+            return list(resp.verdicts)
+
+    @property
+    def verify_fn(self) -> Callable[..., List[bool]]:
+        """(pks, msgs, sigs) -> List[bool]; plugs into VerifyScheduler,
+        Ed25519BatchVerifier, and any other verify_fn seam."""
+        return self.verify
+
+
+# --- process-wide remote backend -------------------------------------------
+
+_remote_mtx = threading.Lock()
+_remote_addr: str = ""  # config override; env consulted when empty
+_remote_client: Optional[VerifydClient] = None
+_remote_client_addr: str = ""
+
+
+def set_remote_addr(addr: str) -> None:
+    """Config-driven override of the remote verifier address (node
+    assembly calls this from ``[ops] verify_remote``). Empty string
+    clears the override; the env var still applies."""
+    global _remote_addr
+    with _remote_mtx:
+        _remote_addr = addr or ""
+
+
+def reset_remote() -> None:
+    """Drop the override AND the cached client (tests)."""
+    global _remote_addr, _remote_client, _remote_client_addr
+    with _remote_mtx:
+        _remote_addr = ""
+        if _remote_client is not None:
+            _remote_client.close()
+        _remote_client = None
+        _remote_client_addr = ""
+
+
+def remote_backend() -> Optional[Callable[..., List[bool]]]:
+    """The configured remote's verify_fn, or None. The client is cached
+    process-wide and rebuilt when the address changes."""
+    global _remote_client, _remote_client_addr
+    with _remote_mtx:
+        addr = _remote_addr or os.environ.get(REMOTE_ENV, "")
+        if not addr:
+            return None
+        if _remote_client is None or _remote_client_addr != addr:
+            if _remote_client is not None:
+                _remote_client.close()
+            _remote_client = VerifydClient(addr)
+            _remote_client_addr = addr
+        return _remote_client.verify

@@ -1,0 +1,232 @@
+"""verifyd wire protocol: compact length-delimited request/response.
+
+Rides the repo's own protobuf wire codec (encoding/proto.py) over the
+zero-dependency gRPC transport (libs/grpc.py) — one unary method:
+
+    /tendermint.verifyd.Verifier/Verify
+
+Request (proto wire form):
+    1  kind      varint   VERIFY_RAW | VERIFY_COMMIT | VERIFY_HEADER
+    2  klass     varint   priority class: consensus < blocksync < light < rpc
+                          (lower value = higher priority; the wire value
+                          is class+1 so consensus=0 survives proto3
+                          zero-omission — absent defaults to rpc)
+    3  deadline  varint   relative deadline in ms (0 = none); relative —
+                          not absolute — so no clock sync is assumed
+    4  algo      varint   ed25519 | sr25519
+    5  lanes     repeated message { 1 pk, 2 msg, 3 sig }
+
+Response:
+    1  status       varint   OK | RESOURCE_EXHAUSTED | DEADLINE_EXCEEDED
+                             | INVALID | INTERNAL
+    2  verdicts     bytes    one byte per lane (1 = valid), only on OK
+    3  message      string   human-readable detail on non-OK
+    4  queue_depth  varint   server pending depth at respond time
+                             (client-side load hint)
+
+``kind`` is advisory: commit semantics (tallying, sign-bytes
+construction) stay on the client; the server sees only raw lanes, so
+every kind funnels into the same shared scheduler. The kind labels
+metrics and picks the default class when the caller sets none.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+from tendermint_tpu.encoding.proto import (
+    WIRE_BYTES,
+    WIRE_VARINT,
+    Reader,
+    encode_bytes_field,
+    encode_varint_field,
+    encode_string_field,
+)
+
+VERIFY_PATH = "/tendermint.verifyd.Verifier/Verify"
+
+# request kinds
+KIND_RAW = 1
+KIND_COMMIT = 2
+KIND_HEADER = 3
+KIND_NAMES = {KIND_RAW: "raw", KIND_COMMIT: "commit", KIND_HEADER: "header"}
+
+# priority classes (lower value = flushed first when over-subscribed)
+CLASS_CONSENSUS = 0
+CLASS_BLOCKSYNC = 1
+CLASS_LIGHT = 2
+CLASS_RPC = 3
+CLASS_NAMES = {
+    CLASS_CONSENSUS: "consensus",
+    CLASS_BLOCKSYNC: "blocksync",
+    CLASS_LIGHT: "light",
+    CLASS_RPC: "rpc",
+}
+# classes the admission controller may shed; consensus/blocksync always
+# get through (shedding them stalls the chain, not just a reader)
+SHEDDABLE_CLASSES = (CLASS_LIGHT, CLASS_RPC)
+
+# signature algorithms
+ALGO_ED25519 = 0
+ALGO_SR25519 = 1
+ALGO_NAMES = {ALGO_ED25519: "ed25519", ALGO_SR25519: "sr25519"}
+
+# response statuses
+STATUS_OK = 0
+STATUS_RESOURCE_EXHAUSTED = 1
+STATUS_DEADLINE_EXCEEDED = 2
+STATUS_INVALID = 3
+STATUS_INTERNAL = 4
+STATUS_NAMES = {
+    STATUS_OK: "ok",
+    STATUS_RESOURCE_EXHAUSTED: "resource_exhausted",
+    STATUS_DEADLINE_EXCEEDED: "deadline_exceeded",
+    STATUS_INVALID: "invalid",
+    STATUS_INTERNAL: "internal",
+}
+
+PUBKEY_SIZE = 32  # ed25519 and sr25519 (ristretto) public keys
+SIG_SIZE = 64
+MAX_LANES = 4096  # hard per-request cap; larger batches split client-side
+MAX_MSG_SIZE = 1 << 20  # 1 MiB per lane message
+
+
+@dataclass
+class VerifyRequest:
+    kind: int = KIND_RAW
+    klass: int = CLASS_RPC
+    deadline_ms: int = 0
+    algo: int = ALGO_ED25519
+    pks: List[bytes] = field(default_factory=list)
+    msgs: List[bytes] = field(default_factory=list)
+    sigs: List[bytes] = field(default_factory=list)
+
+    def __len__(self) -> int:
+        return len(self.pks)
+
+
+@dataclass
+class VerifyResponse:
+    status: int = STATUS_OK
+    verdicts: List[bool] = field(default_factory=list)
+    message: str = ""
+    queue_depth: int = 0
+
+
+def _encode_lane(pk: bytes, msg: bytes, sig: bytes) -> bytes:
+    return (
+        encode_bytes_field(1, pk)
+        + encode_bytes_field(2, msg)
+        + encode_bytes_field(3, sig)
+    )
+
+
+def encode_request(req: VerifyRequest) -> bytes:
+    out = bytearray()
+    if req.kind:
+        out += encode_varint_field(1, req.kind)
+    # klass rides the wire +1: CLASS_CONSENSUS is 0, and proto3
+    # zero-omission would otherwise make it indistinguishable from
+    # "unset" (which defaults to the sheddable rpc class)
+    out += encode_varint_field(2, req.klass + 1)
+    if req.deadline_ms:
+        out += encode_varint_field(3, req.deadline_ms)
+    if req.algo:
+        out += encode_varint_field(4, req.algo)
+    for pk, msg, sig in zip(req.pks, req.msgs, req.sigs):
+        out += encode_bytes_field(5, _encode_lane(pk, msg, sig))
+    return bytes(out)
+
+
+def decode_request(data: bytes) -> VerifyRequest:
+    """Decode + validate; raises ValueError on any malformed input so the
+    server can answer STATUS_INVALID instead of crashing a stream."""
+    req = VerifyRequest(kind=KIND_RAW, klass=CLASS_RPC)
+    try:
+        r = Reader(data)
+        for fld, wire in r.fields():
+            if fld == 1 and wire == WIRE_VARINT:
+                req.kind = r.read_varint()
+            elif fld == 2 and wire == WIRE_VARINT:
+                req.klass = r.read_varint() - 1
+            elif fld == 3 and wire == WIRE_VARINT:
+                req.deadline_ms = r.read_varint()
+            elif fld == 4 and wire == WIRE_VARINT:
+                req.algo = r.read_varint()
+            elif fld == 5 and wire == WIRE_BYTES:
+                pk = msg = sig = None
+                lane = Reader(r.read_bytes())
+                for lfld, lwire in lane.fields():
+                    if lfld == 1 and lwire == WIRE_BYTES:
+                        pk = lane.read_bytes()
+                    elif lfld == 2 and lwire == WIRE_BYTES:
+                        msg = lane.read_bytes()
+                    elif lfld == 3 and lwire == WIRE_BYTES:
+                        sig = lane.read_bytes()
+                    else:
+                        lane.skip(lwire)
+                if pk is None or msg is None or sig is None:
+                    raise ValueError("lane missing pk/msg/sig")
+                req.pks.append(pk)
+                req.msgs.append(msg)
+                req.sigs.append(sig)
+            else:
+                r.skip(wire)
+    except ValueError:
+        raise
+    except Exception as exc:  # torn varints etc. from the Reader
+        raise ValueError(f"malformed request: {exc}") from exc
+    if req.kind not in KIND_NAMES:
+        raise ValueError(f"unknown kind {req.kind}")
+    if req.klass not in CLASS_NAMES:
+        raise ValueError(f"unknown class {req.klass}")
+    if req.algo not in ALGO_NAMES:
+        raise ValueError(f"unknown algo {req.algo}")
+    if len(req.pks) > MAX_LANES:
+        raise ValueError(f"too many lanes: {len(req.pks)} > {MAX_LANES}")
+    for pk, msg, sig in zip(req.pks, req.msgs, req.sigs):
+        if len(pk) != PUBKEY_SIZE:
+            raise ValueError(f"bad pubkey size {len(pk)}")
+        if len(sig) != SIG_SIZE:
+            raise ValueError(f"bad signature size {len(sig)}")
+        if len(msg) > MAX_MSG_SIZE:
+            raise ValueError(f"lane message too large: {len(msg)}")
+    return req
+
+
+def encode_response(resp: VerifyResponse) -> bytes:
+    out = bytearray()
+    if resp.status:
+        out += encode_varint_field(1, resp.status)
+    if resp.verdicts:
+        out += encode_bytes_field(
+            2, bytes(1 if ok else 0 for ok in resp.verdicts)
+        )
+    if resp.message:
+        out += encode_string_field(3, resp.message)
+    if resp.queue_depth:
+        out += encode_varint_field(4, resp.queue_depth)
+    return bytes(out)
+
+
+def decode_response(data: bytes) -> VerifyResponse:
+    resp = VerifyResponse()
+    try:
+        r = Reader(data)
+        for fld, wire in r.fields():
+            if fld == 1 and wire == WIRE_VARINT:
+                resp.status = r.read_varint()
+            elif fld == 2 and wire == WIRE_BYTES:
+                resp.verdicts = [b == 1 for b in r.read_bytes()]
+            elif fld == 3 and wire == WIRE_BYTES:
+                resp.message = r.read_bytes().decode("utf-8", "replace")
+            elif fld == 4 and wire == WIRE_VARINT:
+                resp.queue_depth = r.read_varint()
+            else:
+                r.skip(wire)
+    except Exception as exc:
+        raise ValueError(f"malformed response: {exc}") from exc
+    if resp.status not in STATUS_NAMES:
+        raise ValueError(f"unknown status {resp.status}")
+    return resp

@@ -1,0 +1,366 @@
+"""verifyd server: one shared scheduler, many client connections.
+
+The daemon owns the accelerator and serves batched verification over
+the zero-dependency gRPC transport. Every connection's lanes funnel
+into ONE ``VerifyScheduler`` per algorithm, so batches form ACROSS
+clients — a lone light client's header check rides the same device
+launch as a validator's commit flood. Scheduling behavior:
+
+- deadline-aware flush: each lane carries ``flush_by`` derived from the
+  request's wire deadline (minus a respond margin), so the accumulator
+  flushes early rather than letting a lane's deadline expire in queue;
+- priority-ordered dequeue: when more lanes are pending than one batch
+  holds, consensus < blocksync < light/rpc decides who flushes first;
+- admission control: ``light``/``rpc`` requests are shed with an
+  explicit RESOURCE_EXHAUSTED response — never a silent drop — when
+  queue depth or estimated service time exceeds budget.
+  ``consensus``/``blocksync`` are never shed (losing them stalls the
+  chain, not just a reader); they land in the scheduler's own
+  ``max_pending`` backstop instead.
+
+The verify path under the scheduler is the existing stack: tiered
+host/device dispatch, device health state machine, and the validator
+precompute cache all apply unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.crypto.scheduler import (
+    SchedulerSaturatedError,
+    VerifyScheduler,
+)
+from tendermint_tpu.libs import tracing
+from tendermint_tpu.libs.grpc import GrpcServer
+from tendermint_tpu.libs.metrics import VerifydMetrics
+from tendermint_tpu.verifyd import protocol
+from tendermint_tpu.verifyd.protocol import (
+    ALGO_ED25519,
+    ALGO_SR25519,
+    CLASS_NAMES,
+    KIND_NAMES,
+    SHEDDABLE_CLASSES,
+    STATUS_DEADLINE_EXCEEDED,
+    STATUS_INTERNAL,
+    STATUS_INVALID,
+    STATUS_NAMES,
+    STATUS_OK,
+    STATUS_RESOURCE_EXHAUSTED,
+    VERIFY_PATH,
+)
+
+DEFAULT_ADMISSION_CAP = 1024  # pending-lane ceiling for sheddable classes
+DEFAULT_MAX_PENDING = 4096  # hard scheduler cap (all classes)
+DEFAULT_SERVICE_BUDGET = 0.5  # seconds of estimated queue service time
+DEFAULT_WAIT = 10.0  # verdict wait for requests without a deadline
+_EWMA_ALPHA = 0.2
+
+
+def _default_sr25519_verify(pks, msgs, sigs) -> List[bool]:
+    """Tiered sr25519 dispatch, mirroring the ed25519 policy."""
+    if len(pks) < crypto_batch.DEVICE_THRESHOLD:
+        return _host_sr25519_verify(pks, msgs, sigs)
+    from tendermint_tpu.ops.sr25519_batch import verify_batch_sr
+
+    return list(verify_batch_sr(pks, msgs, sigs))
+
+
+def _host_sr25519_verify(pks, msgs, sigs) -> List[bool]:
+    from tendermint_tpu.crypto.sr25519 import verify as sr_verify
+
+    return [sr_verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+
+
+class AdmissionController:
+    """Sheds sheddable-class load when the queue is past budget.
+
+    Two trip-wires, both checked at enqueue time: pending depth past
+    ``cap`` lanes, or estimated service time for the queue (EWMA
+    per-lane flush cost x depth) past ``service_budget`` seconds. The
+    estimate learns from real flushes via ``observe_flush``.
+    """
+
+    def __init__(
+        self,
+        cap: int = DEFAULT_ADMISSION_CAP,
+        service_budget: float = DEFAULT_SERVICE_BUDGET,
+    ):
+        self.cap = cap
+        self.service_budget = service_budget
+        self._lane_ewma = 0.0  # seconds per lane, learned
+        self._mtx = threading.Lock()
+
+    def observe_flush(self, lanes: int, seconds: float) -> None:
+        if lanes <= 0 or seconds <= 0:
+            return
+        per_lane = seconds / lanes
+        with self._mtx:
+            if self._lane_ewma == 0.0:
+                self._lane_ewma = per_lane
+            else:
+                self._lane_ewma += _EWMA_ALPHA * (per_lane - self._lane_ewma)
+
+    def estimated_service_time(self, depth: int) -> float:
+        with self._mtx:
+            return depth * self._lane_ewma
+
+    def admit(self, klass: int, lanes: int, depth: int) -> Optional[str]:
+        """None = admitted; else the shed reason. Only sheddable
+        classes (light/rpc) are ever refused here."""
+        if klass not in SHEDDABLE_CLASSES:
+            return None
+        if depth + lanes > self.cap:
+            return "queue_depth"
+        if self.estimated_service_time(depth + lanes) > self.service_budget:
+            return "service_time"
+        return None
+
+
+class VerifydServer:
+    """The verification daemon. ``verify_fn`` defaults to the tiered
+    host/device ed25519 dispatch; tests inject a host oracle."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_batch: int = 256,
+        max_delay: float = 0.002,
+        admission_cap: int = DEFAULT_ADMISSION_CAP,
+        max_pending: int = DEFAULT_MAX_PENDING,
+        service_budget: float = DEFAULT_SERVICE_BUDGET,
+        verify_fn: Optional[Callable[..., List[bool]]] = None,
+        sr25519_verify_fn: Optional[Callable[..., List[bool]]] = None,
+        metrics: Optional[VerifydMetrics] = None,
+    ):
+        self.metrics = metrics or VerifydMetrics.nop()
+        self.max_delay = max_delay
+        self.admission = AdmissionController(admission_cap, service_budget)
+        self._verify_fns = {
+            ALGO_ED25519: (
+                verify_fn or crypto_batch.tiered_verify_ed25519,
+                crypto_batch.host_verify_ed25519,
+            ),
+            ALGO_SR25519: (
+                sr25519_verify_fn or _default_sr25519_verify,
+                _host_sr25519_verify,
+            ),
+        }
+        self._sched_args = dict(
+            max_batch=max_batch, max_delay=max_delay, max_pending=max_pending
+        )
+        self._schedulers: Dict[int, VerifyScheduler] = {}
+        self._sched_mtx = threading.Lock()
+        self._depth_mtx = threading.Lock()
+        self._class_depth: Dict[int, int] = {}
+        # plain counters for tests and bench (metrics-free introspection)
+        self.cross_client_flushes: Dict[str, int] = {
+            "size": 0, "deadline": 0, "shutdown": 0,
+        }
+        self.admission_rejections = 0
+        self.deadline_expired = 0
+        self.requests_served = 0
+        self._grpc = GrpcServer({VERIFY_PATH: self._handle}, host, port)
+
+    # --- lifecycle ----------------------------------------------------------
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._grpc.address
+
+    @property
+    def scheduler(self) -> VerifyScheduler:
+        """The ed25519 scheduler (the common case; tests poke it)."""
+        return self._scheduler_for(ALGO_ED25519)
+
+    def start(self) -> None:
+        self._scheduler_for(ALGO_ED25519)  # eager: first request is hot
+        self._grpc.start()
+
+    def stop(self) -> None:
+        self._grpc.stop()
+        with self._sched_mtx:
+            scheds, self._schedulers = dict(self._schedulers), {}
+        for sched in scheds.values():
+            sched.stop()
+
+    def _scheduler_for(self, algo: int) -> VerifyScheduler:
+        with self._sched_mtx:
+            sched = self._schedulers.get(algo)
+            if sched is None:
+                verify_fn, fallback_fn = self._verify_fns[algo]
+                sched = VerifyScheduler(
+                    verify_fn,
+                    fallback_fn=fallback_fn,
+                    on_flush=self._on_flush,
+                    **self._sched_args,
+                )
+                sched.start()
+                self._schedulers[algo] = sched
+            return sched
+
+    # --- flush observer -----------------------------------------------------
+
+    def _on_flush(self, reason: str, batch: list, seconds: float) -> None:
+        lanes = len(batch)
+        self.admission.observe_flush(lanes, seconds)
+        self.metrics.flushes.labels(reason=reason).inc()
+        self.metrics.batch_occupancy.observe(lanes)
+        if len({p.tag for p in batch}) > 1:
+            self.cross_client_flushes[reason] = (
+                self.cross_client_flushes.get(reason, 0) + 1
+            )
+            self.metrics.cross_client_flushes.labels(reason=reason).inc()
+
+    # --- per-class depth gauge ----------------------------------------------
+
+    def _track_depth(self, klass: int, delta: int) -> None:
+        with self._depth_mtx:
+            depth = self._class_depth.get(klass, 0) + delta
+            self._class_depth[klass] = max(0, depth)
+            self.metrics.queue_depth.labels(klass=CLASS_NAMES[klass]).set(
+                self._class_depth[klass]
+            )
+
+    # --- request handler ----------------------------------------------------
+
+    def _respond(
+        self,
+        status: int,
+        verdicts: List[bool],
+        message: str,
+        t0: float,
+        kind_name: str,
+        queue_depth: int = 0,
+    ) -> bytes:
+        with tracing.span("verifyd_respond", status=STATUS_NAMES[status]):
+            self.requests_served += 1
+            self.metrics.requests.labels(
+                kind=kind_name, status=STATUS_NAMES[status]
+            ).inc()
+            self.metrics.request_seconds.labels(kind=kind_name).observe(
+                time.monotonic() - t0
+            )
+            return protocol.encode_response(
+                protocol.VerifyResponse(
+                    status=status,
+                    verdicts=verdicts,
+                    message=message,
+                    queue_depth=queue_depth,
+                )
+            )
+
+    def _handle(self, payload: bytes) -> bytes:
+        t0 = time.monotonic()
+        kind_name = "raw"
+        try:
+            with tracing.span("verifyd_decode", nbytes=len(payload)):
+                try:
+                    req = protocol.decode_request(payload)
+                except ValueError as exc:
+                    return self._respond(
+                        STATUS_INVALID, [], str(exc), t0, kind_name
+                    )
+            kind_name = KIND_NAMES[req.kind]
+            klass_name = CLASS_NAMES[req.klass]
+            n = len(req)
+            if n == 0:
+                return self._respond(STATUS_OK, [], "", t0, kind_name)
+            sched = self._scheduler_for(req.algo)
+            deadline_s = req.deadline_ms / 1000.0 if req.deadline_ms else 0.0
+
+            depth = sched.pending_depth()
+            shed = self.admission.admit(req.klass, n, depth)
+            if shed is not None:
+                self.admission_rejections += 1
+                self.metrics.admission_rejections.labels(
+                    klass=klass_name, reason=shed
+                ).inc()
+                tracing.instant(
+                    "verifyd_shed", klass=klass_name, reason=shed, lanes=n
+                )
+                return self._respond(
+                    STATUS_RESOURCE_EXHAUSTED,
+                    [],
+                    f"{klass_name} load shed ({shed}, {depth} pending)",
+                    t0,
+                    kind_name,
+                    depth,
+                )
+
+            # enqueue: the wire deadline (minus a respond margin) becomes
+            # the lane's flush_by so the scheduler flushes early instead
+            # of letting the deadline lapse inside the accumulator
+            flush_by = None
+            if deadline_s:
+                margin = max(0.001, 0.2 * deadline_s)
+                flush_by = t0 + max(0.0, deadline_s - margin)
+            tag = threading.get_ident()  # one handler thread per connection
+            entries = []
+            try:
+                with tracing.span(
+                    "verifyd_enqueue", lanes=n, klass=klass_name
+                ):
+                    for pk, msg, sig in zip(req.pks, req.msgs, req.sigs):
+                        entries.append(
+                            sched.submit(
+                                pk,
+                                msg,
+                                sig,
+                                priority=req.klass,
+                                flush_by=flush_by,
+                                tag=tag,
+                            )
+                        )
+            except SchedulerSaturatedError as exc:
+                # lanes submitted before saturation still flush; their
+                # verdicts are simply unread (rare, bounded waste)
+                self.metrics.admission_rejections.labels(
+                    klass=klass_name, reason="saturated"
+                ).inc()
+                return self._respond(
+                    STATUS_RESOURCE_EXHAUSTED,
+                    [],
+                    str(exc),
+                    t0,
+                    kind_name,
+                    sched.pending_depth(),
+                )
+            self._track_depth(req.klass, n)
+            self.metrics.lanes.labels(klass=klass_name).inc(n)
+
+            try:
+                verdicts: List[bool] = []
+                with tracing.span("verifyd_wait", lanes=n):
+                    for entry in entries:
+                        if deadline_s:
+                            left = deadline_s - (time.monotonic() - t0)
+                            if left <= 0 or not entry.done.wait(timeout=left):
+                                self.deadline_expired += 1
+                                return self._respond(
+                                    STATUS_DEADLINE_EXCEEDED,
+                                    [],
+                                    f"deadline ({req.deadline_ms}ms) expired"
+                                    " awaiting flush",
+                                    t0,
+                                    kind_name,
+                                    sched.pending_depth(),
+                                )
+                            verdicts.append(entry.ok)
+                        else:
+                            verdicts.append(
+                                sched.wait(entry, timeout=DEFAULT_WAIT)
+                            )
+            finally:
+                self._track_depth(req.klass, -n)
+            return self._respond(
+                STATUS_OK, verdicts, "", t0, kind_name, sched.pending_depth()
+            )
+        except Exception as exc:  # never tear the stream on a handler bug
+            return self._respond(
+                STATUS_INTERNAL, [], repr(exc), t0, kind_name
+            )

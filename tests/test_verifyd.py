@@ -1,0 +1,537 @@
+"""verifyd: the standalone verification service (tendermint_tpu/verifyd/).
+
+Pins the PR-4 serving contract: cross-client dynamic batching through
+one shared scheduler, priority-ordered dequeue, explicit admission
+rejection of sheddable load, deadline-expired responses, client retry
+across a server restart, and remote-backend parity for verify_commit
+against the in-process oracle.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tests.helpers import (
+    CHAIN_ID,
+    make_block_id,
+    make_commit,
+    make_validators,
+)
+from tendermint_tpu.crypto.ed25519_ref import verify_zip215
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.crypto.scheduler import (
+    SchedulerSaturatedError,
+    VerifyScheduler,
+)
+from tendermint_tpu.types import validation
+from tendermint_tpu.verifyd import client as vclient
+from tendermint_tpu.verifyd import protocol
+from tendermint_tpu.verifyd.client import (
+    VerifydClient,
+    VerifydRejectedError,
+    classify,
+    current_class,
+)
+from tendermint_tpu.verifyd.server import VerifydServer
+
+
+def host_verify(pks, msgs, sigs):
+    return [verify_zip215(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+
+
+def make_lanes(n, seed=0, bad=()):
+    """n signed (pk, msg, sig) lanes; indices in ``bad`` get garbage."""
+    priv = Ed25519PrivKey.from_seed(bytes([seed] * 32))
+    pk = priv.pub_key().bytes()
+    msgs = [b"lane-%d-%d" % (seed, i) for i in range(n)]
+    sigs = [
+        bytes(64) if i in bad else priv.sign(m) for i, m in enumerate(msgs)
+    ]
+    return [pk] * n, msgs, sigs
+
+
+# --- protocol codec ---------------------------------------------------------
+
+
+def test_protocol_request_roundtrip():
+    pks, msgs, sigs = make_lanes(3, bad={1})
+    req = protocol.VerifyRequest(
+        kind=protocol.KIND_COMMIT,
+        klass=protocol.CLASS_CONSENSUS,
+        deadline_ms=250,
+        algo=protocol.ALGO_ED25519,
+        pks=pks,
+        msgs=msgs,
+        sigs=sigs,
+    )
+    got = protocol.decode_request(protocol.encode_request(req))
+    assert got == req
+
+
+def test_protocol_response_roundtrip():
+    resp = protocol.VerifyResponse(
+        status=protocol.STATUS_OK,
+        verdicts=[True, False, True],
+        message="",
+        queue_depth=7,
+    )
+    got = protocol.decode_response(protocol.encode_response(resp))
+    assert got == resp
+
+
+def test_protocol_rejects_malformed():
+    with pytest.raises(ValueError):
+        protocol.decode_request(b"\xff\xff\xff")  # torn varint
+    # bad pubkey size
+    req = protocol.VerifyRequest(
+        pks=[b"short"], msgs=[b"m"], sigs=[bytes(64)]
+    )
+    with pytest.raises(ValueError):
+        protocol.decode_request(protocol.encode_request(req))
+    # unknown class
+    pks, msgs, sigs = make_lanes(1)
+    req = protocol.VerifyRequest(klass=9, pks=pks, msgs=msgs, sigs=sigs)
+    with pytest.raises(ValueError):
+        protocol.decode_request(protocol.encode_request(req))
+
+
+def test_classify_outermost_wins():
+    assert current_class() is None
+    with classify(protocol.CLASS_LIGHT):
+        assert current_class() == protocol.CLASS_LIGHT
+        with classify(protocol.CLASS_BLOCKSYNC):  # inner does not override
+            assert current_class() == protocol.CLASS_LIGHT
+        assert current_class() == protocol.CLASS_LIGHT
+    assert current_class() is None
+
+
+# --- scheduler extensions (satellite) ---------------------------------------
+
+
+def test_scheduler_backpressure_rejects_past_cap():
+    gate = threading.Event()
+
+    def gated(pks, msgs, sigs):
+        gate.wait(10)
+        return [True] * len(pks)
+
+    s = VerifyScheduler(gated, max_batch=64, max_delay=0.5, max_pending=3)
+    s.start()
+    try:
+        pks, msgs, sigs = make_lanes(4)
+        entries = [
+            s.submit(pks[i], msgs[i], sigs[i]) for i in range(3)
+        ]
+        with pytest.raises(SchedulerSaturatedError):
+            s.submit(pks[3], msgs[3], sigs[3])
+        assert s.submit_rejections == 1
+        gate.set()
+        assert all(s.wait(e, 5) for e in entries)
+    finally:
+        gate.set()
+        s.stop()
+
+
+def test_scheduler_flush_reason_counters():
+    s = VerifyScheduler(host_verify, max_batch=2, max_delay=0.02)
+    s.start()
+    try:
+        pks, msgs, sigs = make_lanes(3)
+        # size flush: two entries hit max_batch
+        e0 = s.submit(pks[0], msgs[0], sigs[0])
+        e1 = s.submit(pks[1], msgs[1], sigs[1])
+        assert s.wait(e0, 5) and s.wait(e1, 5)
+        assert s.flush_reasons["size"] == 1
+        # deadline flush: a lone entry waits out max_delay
+        e2 = s.submit(pks[2], msgs[2], sigs[2])
+        assert s.wait(e2, 5)
+        assert s.flush_reasons["deadline"] == 1
+    finally:
+        s.stop()
+
+
+def test_scheduler_stop_fails_pending_and_counts_shutdown():
+    # max_delay is huge, so the submitted lanes are still pending when
+    # stop() lands: they must resolve failed-closed, never hang waiters
+    s = VerifyScheduler(host_verify, max_batch=64, max_delay=10.0)
+    s.start()
+    pks, msgs, sigs = make_lanes(2)
+    e0 = s.submit(pks[0], msgs[0], sigs[0])
+    e1 = s.submit(pks[1], msgs[1], sigs[1])
+    s.stop()
+    assert e0.done.is_set() and e1.done.is_set()
+    assert e0.ok is False and e1.ok is False
+    assert s.flush_reasons["shutdown"] == 1
+
+
+def test_scheduler_flush_by_pulls_deadline_earlier():
+    s = VerifyScheduler(host_verify, max_batch=64, max_delay=5.0)
+    s.start()
+    try:
+        pks, msgs, sigs = make_lanes(1)
+        t0 = time.monotonic()
+        e = s.submit(
+            pks[0], msgs[0], sigs[0], flush_by=time.monotonic() + 0.05
+        )
+        assert s.wait(e, 5)
+        # flushed at flush_by (~50ms), nowhere near max_delay (5s)
+        assert time.monotonic() - t0 < 1.0
+        assert s.flush_reasons["deadline"] == 1
+    finally:
+        s.stop()
+
+
+def test_scheduler_priority_ordering_under_load():
+    gate = threading.Event()
+    flushed = []
+
+    def gated(pks, msgs, sigs):
+        gate.wait(10)
+        return [True] * len(pks)
+
+    s = VerifyScheduler(
+        gated,
+        max_batch=4,
+        max_delay=0.01,
+        on_flush=lambda reason, batch, secs: flushed.append(
+            [p.priority for p in batch]
+        ),
+    )
+    s.start()
+    try:
+        pks, msgs, sigs = make_lanes(1)
+        # first flush blocks inside verify, holding the accumulator
+        s.submit(pks[0], msgs[0], sigs[0], priority=3)
+        deadline = time.monotonic() + 5
+        while s.pending_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert s.pending_depth() == 0  # the accumulator took it
+        # pile up 6 light lanes and ONE consensus lane behind the block
+        lp, lm, ls = make_lanes(6, seed=1)
+        for i in range(6):
+            s.submit(lp[i], lm[i], ls[i], priority=protocol.CLASS_LIGHT)
+        cp, cm, cs = make_lanes(1, seed=2)
+        e_cons = s.submit(
+            cp[0], cm[0], cs[0], priority=protocol.CLASS_CONSENSUS
+        )
+        gate.set()
+        assert s.wait(e_cons, 5)
+        # 7 pending > max_batch 4: the first post-release flush must be
+        # priority-ordered with the consensus lane in front
+        assert len(flushed) >= 2
+        assert flushed[1][0] == protocol.CLASS_CONSENSUS
+        assert all(p == protocol.CLASS_LIGHT for p in flushed[1][1:])
+    finally:
+        gate.set()
+        s.stop()
+
+
+# --- server + client over the wire ------------------------------------------
+
+
+def test_single_client_roundtrip_with_bad_lane():
+    srv = VerifydServer(verify_fn=host_verify, max_batch=8, max_delay=0.01)
+    srv.start()
+    try:
+        h, p = srv.address
+        c = VerifydClient(f"{h}:{p}")
+        pks, msgs, sigs = make_lanes(5, bad={2})
+        assert c.verify(pks, msgs, sigs) == [True, True, False, True, True]
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_cross_client_batching_four_connections():
+    """Lanes from >= 4 concurrent client connections coalesce into
+    shared batches: the size-flush fires across clients (flush-reason
+    counters + the server's cross-client flush counter prove it)."""
+    lanes_per_client = 4
+    n_clients = 4
+    srv = VerifydServer(
+        verify_fn=host_verify,
+        max_batch=lanes_per_client * n_clients,
+        max_delay=2.0,  # long: only a SIZE flush answers before this
+    )
+    srv.start()
+    h, p = srv.address
+    results = {}
+    errors = []
+    barrier = threading.Barrier(n_clients)
+
+    def run(i):
+        try:
+            c = VerifydClient(f"{h}:{p}")
+            pks, msgs, sigs = make_lanes(lanes_per_client, seed=i)
+            barrier.wait(timeout=5)
+            results[i] = c.verify(pks, msgs, sigs)
+            c.close()
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errors.append(exc)
+
+    try:
+        threads = [
+            threading.Thread(target=run, args=(i,)) for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+        assert all(
+            results[i] == [True] * lanes_per_client for i in range(n_clients)
+        )
+        # the 16 lanes arrived through 4 connections and flushed as ONE
+        # size-triggered batch spanning multiple clients
+        assert srv.scheduler.flush_reasons["size"] >= 1
+        assert srv.cross_client_flushes["size"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_admission_rejects_light_while_consensus_verifies():
+    """An over-cap light request gets an explicit RESOURCE_EXHAUSTED
+    while a concurrent consensus request still verifies correctly."""
+    gate = threading.Event()
+    in_flight = threading.Event()  # set once a flush is INSIDE verify
+
+    def gated(pks, msgs, sigs):
+        in_flight.set()
+        gate.wait(10)
+        return host_verify(pks, msgs, sigs)
+
+    srv = VerifydServer(
+        verify_fn=gated, admission_cap=4, max_batch=64, max_delay=0.02
+    )
+    srv.start()
+    h, p = srv.address
+    cons_results = {}
+    errors = []
+
+    def consensus_call(i):
+        try:
+            c = VerifydClient(f"{h}:{p}")
+            pks, msgs, sigs = make_lanes(6, seed=i)
+            cons_results[i] = c.verify(
+                pks, msgs, sigs, klass=protocol.CLASS_CONSENSUS
+            )
+            c.close()
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    try:
+        sched = srv.scheduler
+        # first consensus batch: taken by the accumulator, blocked in
+        # the gated verify_fn
+        t1 = threading.Thread(target=consensus_call, args=(1,))
+        t1.start()
+        assert in_flight.wait(timeout=5)
+        # second consensus batch queues behind the blocked flush:
+        # consensus is NEVER shed, even past the admission cap
+        t2 = threading.Thread(target=consensus_call, args=(2,))
+        t2.start()
+        deadline = time.monotonic() + 5
+        while sched.pending_depth() < 6 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert sched.pending_depth() >= 6
+        # light request over the cap: explicit rejection, never silent
+        c3 = VerifydClient(f"{h}:{p}", fallback=False)
+        pks, msgs, sigs = make_lanes(2, seed=3)
+        with pytest.raises(VerifydRejectedError) as ei:
+            c3.verify(pks, msgs, sigs, klass=protocol.CLASS_LIGHT)
+        assert ei.value.status == protocol.STATUS_RESOURCE_EXHAUSTED
+        c3.close()
+        assert srv.admission_rejections >= 1
+        gate.set()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert not errors
+        assert cons_results[1] == [True] * 6
+        assert cons_results[2] == [True] * 6
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_deadline_expired_response():
+    """A request whose deadline lapses while its lanes sit behind a
+    stuck flush gets DEADLINE_EXCEEDED, not a hang."""
+    gate = threading.Event()
+    in_flight = threading.Event()
+
+    def gated(pks, msgs, sigs):
+        in_flight.set()
+        gate.wait(10)
+        return host_verify(pks, msgs, sigs)
+
+    srv = VerifydServer(verify_fn=gated, max_batch=64, max_delay=0.01)
+    srv.start()
+    try:
+        h, p = srv.address
+        # occupy the accumulator with a throwaway lane
+        warm = VerifydClient(f"{h}:{p}")
+        wt = threading.Thread(
+            target=lambda: warm.verify(*make_lanes(1, seed=9))
+        )
+        wt.start()
+        assert in_flight.wait(timeout=5)  # accumulator is now blocked
+        c = VerifydClient(f"{h}:{p}", fallback=False)
+        pks, msgs, sigs = make_lanes(2, seed=4)
+        with pytest.raises(VerifydRejectedError) as ei:
+            c.verify(pks, msgs, sigs, deadline=0.2)
+        assert ei.value.status == protocol.STATUS_DEADLINE_EXCEEDED
+        assert srv.deadline_expired >= 1
+        gate.set()
+        wt.join(timeout=10)
+        c.close()
+        warm.close()
+    finally:
+        gate.set()
+        srv.stop()
+
+
+def test_client_retries_after_server_restart():
+    srv = VerifydServer(verify_fn=host_verify, max_batch=8, max_delay=0.01)
+    srv.start()
+    h, p = srv.address
+    c = VerifydClient(f"{h}:{p}", retries=6, backoff=0.1, fallback=False)
+    pks, msgs, sigs = make_lanes(3)
+    assert c.verify(pks, msgs, sigs) == [True] * 3
+    srv.stop()
+
+    srv2_box = {}
+
+    def restart():
+        time.sleep(0.3)
+        srv2 = VerifydServer(
+            verify_fn=host_verify, host=h, port=p,
+            max_batch=8, max_delay=0.01,
+        )
+        srv2.start()
+        srv2_box["srv"] = srv2
+
+    t = threading.Thread(target=restart)
+    t.start()
+    try:
+        # first attempts hit a dead port; the backoff retries land on
+        # the restarted server (fallback is OFF: success = the wire)
+        assert c.verify(pks, msgs, sigs) == [True] * 3
+        assert c.transport_retries >= 1
+    finally:
+        t.join(timeout=5)
+        c.close()
+        if "srv" in srv2_box:
+            srv2_box["srv"].stop()
+
+
+def test_client_falls_back_to_host_when_unreachable():
+    c = VerifydClient("127.0.0.1:1", retries=1, backoff=0.01)  # dead port
+    pks, msgs, sigs = make_lanes(3, bad={1})
+    assert c.verify(pks, msgs, sigs) == [True, False, True]
+    assert c.fallback_calls == 1
+    c.close()
+
+
+# --- remote backend parity (acceptance) -------------------------------------
+
+
+def test_verify_commit_remote_parity_24_validators():
+    """verify_commit through the remote backend returns verdicts
+    identical to the in-process path on a 24-validator commit,
+    including the bad-signature attribution."""
+    privs, vset = make_validators(24)
+    bid = make_block_id()
+    good = make_commit(bid, 5, 0, vset, privs)
+    bad = make_commit(bid, 5, 0, vset, privs)
+    bad.signatures[3].signature = bytes(64)
+
+    # in-process oracle
+    validation.verify_commit(CHAIN_ID, vset, bid, 5, good)
+    with pytest.raises(validation.InvalidCommitError) as inproc_err:
+        validation.verify_commit(CHAIN_ID, vset, bid, 5, bad)
+
+    srv = VerifydServer(verify_fn=host_verify, max_batch=64, max_delay=0.01)
+    srv.start()
+    h, p = srv.address
+    vclient.set_remote_addr(f"{h}:{p}")
+    try:
+        validation.verify_commit(CHAIN_ID, vset, bid, 5, good)
+        assert srv.requests_served >= 1  # the wire actually served it
+        with pytest.raises(validation.InvalidCommitError) as remote_err:
+            validation.verify_commit(CHAIN_ID, vset, bid, 5, bad)
+        # identical verdicts AND identical fault attribution
+        assert str(remote_err.value) == str(inproc_err.value)
+        assert "wrong signature (#3)" in str(remote_err.value)
+        assert srv.requests_served >= 2
+        # consensus classification rode the wire
+        assert srv.scheduler.flush_reasons["size"] + \
+            srv.scheduler.flush_reasons["deadline"] >= 2
+    finally:
+        vclient.reset_remote()
+        srv.stop()
+
+
+def test_remote_backend_env_selection(monkeypatch):
+    srv = VerifydServer(verify_fn=host_verify, max_batch=8, max_delay=0.01)
+    srv.start()
+    h, p = srv.address
+    try:
+        monkeypatch.delenv(vclient.REMOTE_ENV, raising=False)
+        vclient.reset_remote()
+        assert vclient.remote_backend() is None
+        monkeypatch.setenv(vclient.REMOTE_ENV, f"{h}:{p}")
+        fn = vclient.remote_backend()
+        assert fn is not None
+        pks, msgs, sigs = make_lanes(2, bad={0})
+        assert fn(pks, msgs, sigs) == [False, True]
+        assert srv.requests_served >= 1
+    finally:
+        vclient.reset_remote()
+        srv.stop()
+
+
+def test_verifyd_metrics_populate():
+    from tendermint_tpu.libs.metrics import Registry, VerifydMetrics
+
+    reg = Registry()
+    srv = VerifydServer(
+        verify_fn=host_verify,
+        max_batch=8,
+        max_delay=0.01,
+        metrics=VerifydMetrics(reg),
+    )
+    srv.start()
+    try:
+        h, p = srv.address
+        c = VerifydClient(f"{h}:{p}")
+        pks, msgs, sigs = make_lanes(3)
+        assert c.verify(pks, msgs, sigs) == [True] * 3
+        c.close()
+        text = reg.expose()
+        assert 'tendermint_verifyd_requests_total{kind="raw",status="ok"} 1' \
+            in text
+        assert "tendermint_verifyd_batch_occupancy" in text
+        assert 'tendermint_verifyd_flushes_total' in text
+        assert 'tendermint_verifyd_lanes_total{klass="rpc"} 3' in text
+    finally:
+        srv.stop()
+
+
+def test_sr25519_lanes_over_the_wire():
+    sr25519 = pytest.importorskip("tendermint_tpu.crypto.sr25519")
+    srv = VerifydServer(max_batch=8, max_delay=0.01)  # default verify fns
+    srv.start()
+    try:
+        h, p = srv.address
+        c = VerifydClient(f"{h}:{p}")
+        priv = sr25519.Sr25519PrivKey.from_secret(b"verifyd-sr-lane")
+        msgs = [b"sr-lane-%d" % i for i in range(3)]
+        sigs = [priv.sign(m) for m in msgs]
+        pks = [priv.pub_key().bytes()] * 3
+        sigs[1] = bytes(64)
+        got = c.verify(pks, msgs, sigs, algo=protocol.ALGO_SR25519)
+        assert got == [True, False, True]
+        c.close()
+    finally:
+        srv.stop()
