@@ -1,0 +1,40 @@
+"""tpulint: the project-specific static-analysis suite.
+
+Run as ``python -m scripts.analysis`` from the repo root. See
+``scripts/analysis/README.md`` for the checker-code catalogue and
+``core.py`` for the framework contract.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+from scripts.analysis.core import (  # noqa: F401  (re-exported API)
+    Checker,
+    Finding,
+    Module,
+    Project,
+    Runner,
+    diff_baseline,
+    load_baseline,
+    load_modules,
+    write_baseline,
+)
+from scripts.analysis.hygiene import HygieneChecker
+from scripts.analysis.jaxpurity import JaxPurityChecker
+from scripts.analysis.locks import LockDisciplineChecker
+from scripts.analysis.metrics_checks import MetricsChecker
+from scripts.analysis.wire import WireCompatChecker
+
+#: registration order is report order for equal path:line
+CHECKERS: List[Type[Checker]] = [
+    LockDisciplineChecker,
+    JaxPurityChecker,
+    WireCompatChecker,
+    HygieneChecker,
+    MetricsChecker,
+]
+
+
+def checker_registry() -> Dict[str, Type[Checker]]:
+    return {c.name: c for c in CHECKERS}

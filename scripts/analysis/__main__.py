@@ -1,0 +1,141 @@
+"""CLI for tpulint: ``python -m scripts.analysis [paths...]``.
+
+Default target is the ``tendermint_tpu`` package. Findings print as
+``path:line: CODE message``; exit status is 0 when every finding is
+covered by the baseline (and the baseline has no stale entries), 1
+otherwise. ``--update-baseline`` rewrites the baseline to the current
+finding set — use it only to grandfather debt you are explicitly
+choosing not to fix in this change.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from scripts.analysis import checker_registry
+from scripts.analysis.core import (
+    DEFAULT_BASELINE,
+    REPO_ROOT,
+    Runner,
+    diff_baseline,
+    load_baseline,
+    load_modules,
+    write_baseline,
+)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m scripts.analysis",
+        description="tpulint: project-specific static analysis",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to check (default: tendermint_tpu/)",
+    )
+    p.add_argument(
+        "--list-checkers",
+        action="store_true",
+        help="print the checker and code catalogue, then exit",
+    )
+    p.add_argument(
+        "--enable",
+        action="append",
+        default=[],
+        metavar="NAME",
+        help="run only these checkers (repeatable)",
+    )
+    p.add_argument(
+        "--disable",
+        action="append",
+        default=[],
+        metavar="NAME",
+        help="skip these checkers (repeatable)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline file (default: scripts/analysis/baseline.txt)",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    p.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline to the current finding set",
+    )
+    return p
+
+
+def main(argv: List[str] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    registry = checker_registry()
+
+    if args.list_checkers:
+        for name, cls in registry.items():
+            print(f"{name}:")
+            for code, desc in sorted(cls.codes.items()):
+                print(f"  {code}  {desc}")
+        return 0
+
+    for name in args.enable + args.disable:
+        if name not in registry:
+            print(
+                f"tpulint: unknown checker {name!r} "
+                f"(known: {', '.join(sorted(registry))})",
+                file=sys.stderr,
+            )
+            return 2
+    enabled = list(args.enable) or list(registry)
+    enabled = [n for n in enabled if n not in set(args.disable)]
+    checkers = [registry[n]() for n in enabled]
+
+    roots = args.paths or [os.path.join(REPO_ROOT, "tendermint_tpu")]
+    modules = load_modules(roots)
+    findings = Runner(checkers).run(modules)
+
+    if args.update_baseline:
+        write_baseline(args.baseline, findings)
+        print(
+            f"tpulint: baseline updated with {len(findings)} finding(s) "
+            f"-> {os.path.relpath(args.baseline, REPO_ROOT)}"
+        )
+        return 0
+
+    if args.no_baseline:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"tpulint: {n} finding(s), baseline ignored")
+        return 1 if findings else 0
+
+    baseline = load_baseline(args.baseline)
+    new, stale = diff_baseline(findings, baseline)
+    for f in new:
+        print(f.render())
+    rc = 0
+    if new:
+        print(f"tpulint: {len(new)} new finding(s) not in baseline")
+        rc = 1
+    if stale:
+        for key in stale:
+            print(f"tpulint: stale baseline entry (fixed? remove it): {key}")
+        rc = 1
+    if rc == 0:
+        grandfathered = len(findings)
+        print(
+            f"tpulint: ok ({len(modules)} files, "
+            f"{grandfathered} grandfathered finding(s) in baseline)"
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

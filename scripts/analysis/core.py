@@ -1,0 +1,256 @@
+"""tpulint core: the pluggable AST-analysis framework.
+
+The repo's hard bugs have not been syntax errors — they were lock
+discipline (a field read outside its mutex), trace purity (host work
+baked into a jitted kernel), and wire compatibility (proto3 zero
+omission turning consensus priority into rpc priority). Generic linters
+cannot see those because the invariants are project conventions, not
+language rules. This framework turns each convention into a checker:
+
+- a :class:`Checker` subclass declares its finding ``codes`` and
+  implements ``check_module`` (per-file) and/or ``check_project``
+  (whole-package analyses like the dead-instrument audit);
+- the :class:`Runner` parses every target file once into a
+  :class:`Module` (source, AST, comment map), fans modules out to the
+  enabled checkers, and diffs the findings against a checked-in
+  baseline so pre-existing debt is grandfathered while NEW findings
+  fail CI;
+- output is ``path:line: CODE message`` — the ruff/mypy shape every
+  editor already knows how to jump on.
+
+Suppression, from most to least surgical:
+
+- fix the code;
+- inline ``# tpulint: disable=CODE1,CODE2`` on the offending line;
+- a ``# guarded-by: none(<reason>)`` annotation (lock checker only);
+- the baseline file (``scripts/analysis/baseline.txt``), refreshed via
+  ``--update-baseline`` — for grandfathered findings that should shrink
+  over time, never grow.
+
+Baseline keys are ``path: CODE message`` *without* line numbers (an
+unrelated edit above a finding must not un-grandfather it), compared as
+a multiset so N identical findings in one file need N baseline entries.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from collections import Counter as _Counter
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.txt"
+)
+
+_DISABLE_RE = re.compile(r"tpulint:\s*disable=([A-Z0-9, ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``path:line: CODE message``."""
+
+    path: str  # repo-relative, forward slashes
+    line: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def baseline_key(self) -> str:
+        # Line numbers drift under unrelated edits; the baseline keys on
+        # the stable triple instead.
+        return f"{self.path}: {self.code} {self.message}"
+
+
+class Module:
+    """One parsed source file, shared by every checker.
+
+    ``comments`` maps line number -> comment text (without ``#``),
+    extracted with :mod:`tokenize` so a ``#`` inside a string literal
+    can never masquerade as an annotation.
+    """
+
+    def __init__(self, path: str, source: str, rel: Optional[str] = None):
+        self.path = path
+        self.rel = (rel or path).replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string.lstrip("#").strip()
+        except tokenize.TokenError:
+            pass  # a file that parses but mis-tokenizes keeps an empty map
+
+    def comment_on(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+    def disabled_codes(self, line: int) -> frozenset:
+        """Codes suppressed by ``# tpulint: disable=...`` on this line."""
+        m = _DISABLE_RE.search(self.comments.get(line, ""))
+        if not m:
+            return frozenset()
+        return frozenset(c.strip() for c in m.group(1).split(",") if c.strip())
+
+
+class Project:
+    """The whole target set, for cross-file checkers."""
+
+    def __init__(self, modules: Sequence[Module]):
+        self.modules = list(modules)
+
+    def module(self, rel_suffix: str) -> Optional[Module]:
+        for m in self.modules:
+            if m.rel.endswith(rel_suffix):
+                return m
+        return None
+
+
+class Checker:
+    """Base class: subclasses set ``name``/``codes`` and override one or
+    both hooks. Findings for suppressed lines are filtered centrally."""
+
+    name = "base"
+    #: code -> one-line description (surfaced by --list-checkers)
+    codes: Dict[str, str] = {}
+
+    def check_module(self, module: Module) -> Iterator[Finding]:
+        return iter(())
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        return iter(())
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    """Child -> parent for one tree (ast has no parent links)."""
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``self._m._lock`` -> "self._m._lock"; None for non-name chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# --- discovery ---------------------------------------------------------------
+
+
+def iter_py_files(roots: Sequence[str]) -> Iterator[str]:
+    for root in roots:
+        if os.path.isfile(root):
+            if root.endswith(".py"):
+                yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [
+                d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def load_modules(roots: Sequence[str], repo_root: str = REPO_ROOT) -> List[Module]:
+    modules = []
+    for path in iter_py_files(roots):
+        ap = os.path.abspath(path)
+        rel = os.path.relpath(ap, repo_root)
+        with open(ap, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            modules.append(Module(ap, src, rel=rel))
+        except SyntaxError as exc:
+            raise SystemExit(f"tpulint: cannot parse {rel}: {exc}")
+    return modules
+
+
+# --- baseline ----------------------------------------------------------------
+
+
+def load_baseline(path: str) -> "_Counter[str]":
+    counts: "_Counter[str]" = _Counter()
+    if not os.path.exists(path):
+        return counts
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                counts[line] += 1
+    return counts
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    keys = sorted(f.baseline_key() for f in findings)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(
+            "# tpulint baseline: grandfathered findings (one key per line,\n"
+            "# repeated keys allowed). Regenerate with\n"
+            "#   python -m scripts.analysis --update-baseline\n"
+            "# The goal is for this file to shrink, never grow.\n"
+        )
+        for k in keys:
+            fh.write(k + "\n")
+
+
+# --- runner ------------------------------------------------------------------
+
+
+class Runner:
+    def __init__(self, checkers: Sequence[Checker]):
+        self.checkers = list(checkers)
+
+    def run(self, modules: Sequence[Module]) -> List[Finding]:
+        project = Project(modules)
+        findings: List[Finding] = []
+        for checker in self.checkers:
+            for mod in modules:
+                for f in checker.check_module(mod):
+                    if f.code not in mod.disabled_codes(f.line):
+                        findings.append(f)
+            for f in checker.check_project(project):
+                mod = next(
+                    (m for m in modules if m.rel == f.path), None
+                )
+                if mod is None or f.code not in mod.disabled_codes(f.line):
+                    findings.append(f)
+        findings.sort(key=lambda f: (f.path, f.line, f.code, f.message))
+        return findings
+
+
+def diff_baseline(
+    findings: Sequence[Finding], baseline: "_Counter[str]"
+) -> Tuple[List[Finding], List[str]]:
+    """(new findings not covered by the baseline, stale baseline keys)."""
+    remaining = _Counter(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        key = f.baseline_key()
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+        else:
+            new.append(f)
+    stale = sorted(
+        key for key, n in remaining.items() for _ in range(n) if n > 0
+    )
+    return new, stale

@@ -1,0 +1,182 @@
+"""Hygiene checkers (TPH): exception, thread, and logging discipline.
+
+- TPH001 — bare ``except:``: catches ``SystemExit``/``KeyboardInterrupt``
+  too, which is how a verify daemon ends up unkillable. Name the
+  exception types.
+- TPH002 — ``except <T>: pass`` with no rationale comment: a silent
+  swallow is sometimes right (best-effort close paths), but then it
+  must say WHY on the same line or inside the handler. A comment
+  anywhere in the handler counts as the rationale.
+- TPH003 — ``threading.Thread(...)`` that is neither ``daemon=True``
+  nor ever ``.join()``-ed in the same file: such a thread blocks
+  interpreter shutdown forever if its loop doesn't exit — the exact
+  hang the scheduler's accumulator avoids by being a joined daemon.
+- TPH004 — eager interpolation into a ``libs/log`` logger call:
+  f-strings / ``%`` / ``.format()`` passed as the message build the
+  string even when the level is filtered, and bypass the structured
+  ``key=value`` fields the log format wants. Pass fields as kwargs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from scripts.analysis.core import Checker, Finding, Module, dotted_name
+
+_LOGGER_METHODS = {"debug", "info", "warn", "warning", "error", "critical",
+                   "exception"}
+
+
+def _handler_has_comment(module: Module, handler: ast.ExceptHandler) -> bool:
+    end = handler.end_lineno or handler.lineno
+    for line in range(handler.lineno, end + 1):
+        if module.comment_on(line):
+            return True
+    return False
+
+
+def _is_pass_only(handler: ast.ExceptHandler) -> bool:
+    return len(handler.body) == 1 and isinstance(handler.body[0], ast.Pass)
+
+
+class HygieneChecker(Checker):
+    name = "hygiene"
+    codes = {
+        "TPH001": "bare except: catches SystemExit/KeyboardInterrupt",
+        "TPH002": "silent except-pass without a rationale comment",
+        "TPH003": "non-daemon thread that is never joined",
+        "TPH004": "eager string interpolation into a libs/log logger",
+    }
+
+    def check_module(self, module: Module) -> Iterator[Finding]:
+        yield from self._check_excepts(module)
+        yield from self._check_threads(module)
+        yield from self._check_logging(module)
+
+    # --- exceptions ----------------------------------------------------------
+
+    def _check_excepts(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield Finding(
+                    module.rel,
+                    node.lineno,
+                    "TPH001",
+                    "bare 'except:' also catches SystemExit/"
+                    "KeyboardInterrupt; name the exception types",
+                )
+                continue
+            if _is_pass_only(node) and not _handler_has_comment(module, node):
+                if isinstance(node.type, ast.Tuple):
+                    caught = "(%s)" % ", ".join(
+                        dotted_name(e) or "?" for e in node.type.elts
+                    )
+                else:
+                    caught = dotted_name(node.type) or "exception"
+                yield Finding(
+                    module.rel,
+                    node.lineno,
+                    "TPH002",
+                    f"'except {caught}: pass' swallows errors silently; "
+                    "log it, handle it, or add a rationale comment",
+                )
+
+    # --- threads -------------------------------------------------------------
+
+    def _thread_ctor_daemon(self, call: ast.Call) -> Optional[bool]:
+        """True/False for an explicit daemon= kwarg, None if absent."""
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return None
+
+    def _check_threads(self, module: Module) -> Iterator[Finding]:
+        # Names that get .join()ed or .daemon = True anywhere in the file;
+        # a file-level over-approximation is the right precision here —
+        # the goal is catching threads NOBODY ever reaps.
+        joined: Set[str] = set()
+        daemoned: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+            ):
+                base = dotted_name(node.func.value)
+                if base:
+                    joined.add(base.rsplit(".", 1)[-1])
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                        base = dotted_name(t.value)
+                        if base:
+                            daemoned.add(base.rsplit(".", 1)[-1])
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)):
+                continue
+            callee = dotted_name(node.func) or ""
+            if callee.rsplit(".", 1)[-1] != "Thread":
+                continue
+            daemon = self._thread_ctor_daemon(node)
+            if daemon is True:
+                continue
+            target = self._assigned_name(module, node)
+            if target and (target in joined or target in daemoned):
+                continue
+            yield Finding(
+                module.rel,
+                node.lineno,
+                "TPH003",
+                "thread is not daemon=True and is never joined; it will "
+                "block interpreter shutdown",
+            )
+
+    def _assigned_name(self, module: Module, call: ast.Call) -> Optional[str]:
+        """X for ``X = Thread(...)`` / ``self.X = Thread(...)``."""
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and node.value is call:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        return t.id
+                    if isinstance(t, ast.Attribute):
+                        return t.attr
+        return None
+
+    # --- logging -------------------------------------------------------------
+
+    def _check_logging(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _LOGGER_METHODS
+                and node.args
+            ):
+                continue
+            recv = dotted_name(node.func.value) or ""
+            leaf = recv.rsplit(".", 1)[-1].lower()
+            if "log" not in leaf:
+                continue
+            msg = node.args[0]
+            bad = None
+            if isinstance(msg, ast.JoinedStr):
+                bad = "f-string"
+            elif isinstance(msg, ast.BinOp) and isinstance(msg.op, ast.Mod):
+                bad = "%-format"
+            elif (
+                isinstance(msg, ast.Call)
+                and isinstance(msg.func, ast.Attribute)
+                and msg.func.attr == "format"
+            ):
+                bad = ".format() call"
+            if bad:
+                yield Finding(
+                    module.rel,
+                    msg.lineno,
+                    "TPH004",
+                    f"{bad} interpolated into logger .{node.func.attr}(); "
+                    "pass a constant message with key=value fields",
+                )

@@ -1,0 +1,357 @@
+"""JAX-purity checker (TPJ): trace-time hygiene for the kernel stacks.
+
+Everything reachable from a ``jax.jit`` / ``pl.pallas_call`` entry
+point in ``tendermint_tpu/ops/`` executes at TRACE time and is baked
+into the compiled graph. Host side effects there are at best silently
+frozen into the kernel (a ``time.monotonic()`` reads once, at trace)
+and at worst a concretization error three layers away from the cause.
+The three rules:
+
+- TPJ001 — impure call in a jit-reachable function: ``time.*``,
+  ``random``/``np.random``/``os.urandom``, ``print``/``open``/
+  ``input``, ``os.environ``, logger methods, and ``tracing`` spans
+  (spans belong AROUND the compiled call, never inside the trace).
+- TPJ002 — Python-side branch (``if``/``while``/``assert``) on a traced
+  value: the test references a parameter or local of the kernel
+  function. Branching on static config (module globals like
+  ``_MUL_IMPL``), on ``.shape``/``.ndim``/``.dtype``/``len()``/
+  ``isinstance()`` of a traced value, or on comprehension/loop
+  variables of static ranges is allowed — those are trace-time
+  constants.
+- TPJ003 — dtype discipline: the field kernels are exact in f32 with
+  uint8 wire I/O and int32/int8 MXU contractions; 64-bit and 16-bit
+  dtypes (``int64``/``float64``/``float16``/``bfloat16``) anywhere in
+  ``ops/`` are either silently downcast by jax's x64 default or break
+  the exact-integer range proofs, so both spellings (attribute and
+  string literal) are flagged.
+
+Reachability is a cross-module call graph over the ``ops/`` package:
+entry points are functions passed to ``jax.jit(...)`` (including the
+nested ``def run`` closures in the compiled-kernel caches), functions
+decorated ``@jax.jit``/``@partial(jax.jit, ...)``, and kernels passed
+to ``pl.pallas_call``. Calls resolve by simple name within a module
+and through ``from tendermint_tpu.ops import field32 as field``-style
+aliases across ops modules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from scripts.analysis.core import (
+    Checker,
+    Finding,
+    Module,
+    Project,
+    dotted_name,
+    parent_map,
+)
+
+OPS_PREFIX = "tendermint_tpu/ops/"
+
+_BAD_DTYPES = {"int64", "float64", "float16", "bfloat16"}
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+_STATIC_CALLS = {"len", "isinstance", "range", "enumerate", "zip", "getattr",
+                 "hasattr", "min", "max"}
+_LOGGER_METHODS = {"debug", "info", "warn", "warning", "error"}
+
+
+def _fn_key(mod_rel: str, name: str) -> Tuple[str, str]:
+    return (mod_rel, name)
+
+
+class _FnInfo:
+    def __init__(self, module: Module, node: ast.AST, qualname: str):
+        self.module = module
+        self.node = node
+        self.qualname = qualname
+
+
+class JaxPurityChecker(Checker):
+    name = "jaxpurity"
+    codes = {
+        "TPJ001": "impure call reachable from a jit/pallas entry point",
+        "TPJ002": "Python-side branch on a traced value in a kernel",
+        "TPJ003": "dtype outside the uint8/int32/f32 kernel discipline",
+    }
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        ops_modules = [
+            m for m in project.modules if m.rel.startswith(OPS_PREFIX)
+        ]
+        if not ops_modules:
+            return
+        fns: Dict[Tuple[str, str], _FnInfo] = {}
+        aliases: Dict[str, Dict[str, str]] = {}  # mod.rel -> alias -> mod.rel
+        for mod in ops_modules:
+            aliases[mod.rel] = self._import_aliases(mod, ops_modules)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fns.setdefault(
+                        _fn_key(mod.rel, node.name),
+                        _FnInfo(mod, node, node.name),
+                    )
+        entries = self._entry_points(ops_modules, fns)
+        reachable = self._reach(entries, fns, aliases)
+        for key in sorted(reachable):
+            info = fns.get(key)
+            if info is not None:
+                yield from self._check_fn(info)
+        for mod in ops_modules:
+            yield from self._check_dtypes(mod)
+
+    # --- call graph ----------------------------------------------------------
+
+    def _import_aliases(
+        self, mod: Module, ops_modules: List[Module]
+    ) -> Dict[str, str]:
+        """alias name -> ops module rel path (``field`` -> ops/field32.py)."""
+        by_stem = {
+            m.rel.rsplit("/", 1)[-1][:-3]: m.rel for m in ops_modules
+        }
+        out: Dict[str, str] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    if alias.name in by_stem:
+                        out[alias.asname or alias.name] = by_stem[alias.name]
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    stem = alias.name.rsplit(".", 1)[-1]
+                    if stem in by_stem:
+                        out[alias.asname or stem] = by_stem[stem]
+        return out
+
+    def _entry_points(
+        self,
+        ops_modules: List[Module],
+        fns: Dict[Tuple[str, str], _FnInfo],
+    ) -> Set[Tuple[str, str]]:
+        entries: Set[Tuple[str, str]] = set()
+        for mod in ops_modules:
+            for node in ast.walk(mod.tree):
+                # jax.jit(fn, ...) / jit(fn) / pl.pallas_call(kernel, ...)
+                if isinstance(node, ast.Call):
+                    callee = dotted_name(node.func) or ""
+                    if callee.endswith("jit") or callee.endswith("pallas_call"):
+                        for arg in node.args[:1]:
+                            if isinstance(arg, ast.Name):
+                                key = _fn_key(mod.rel, arg.id)
+                                if key in fns:
+                                    entries.add(key)
+                # decorators
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        target = dec.func if isinstance(dec, ast.Call) else dec
+                        name = dotted_name(target) or ""
+                        inner = ""
+                        if isinstance(dec, ast.Call) and dec.args:
+                            inner = dotted_name(dec.args[0]) or ""
+                        if (
+                            name.endswith("jit")
+                            or (name.endswith("partial") and inner.endswith("jit"))
+                        ):
+                            entries.add(_fn_key(mod.rel, node.name))
+        return entries
+
+    def _calls_of(
+        self, info: _FnInfo, aliases: Dict[str, Dict[str, str]]
+    ) -> Set[Tuple[str, str]]:
+        out: Set[Tuple[str, str]] = set()
+        mod_aliases = aliases.get(info.module.rel, {})
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name):
+                out.add(_fn_key(info.module.rel, fn.id))
+            elif (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in mod_aliases
+            ):
+                out.add(_fn_key(mod_aliases[fn.value.id], fn.attr))
+        return out
+
+    def _reach(
+        self,
+        entries: Set[Tuple[str, str]],
+        fns: Dict[Tuple[str, str], _FnInfo],
+        aliases: Dict[str, Dict[str, str]],
+    ) -> Set[Tuple[str, str]]:
+        seen: Set[Tuple[str, str]] = set()
+        work = [k for k in entries if k in fns]
+        while work:
+            key = work.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            for callee in self._calls_of(fns[key], aliases):
+                if callee in fns and callee not in seen:
+                    work.append(callee)
+        return seen
+
+    # --- per-function rules --------------------------------------------------
+
+    def _check_fn(self, info: _FnInfo) -> Iterator[Finding]:
+        mod = info.module
+        node = info.node
+        params = {
+            a.arg
+            for a in (
+                list(node.args.posonlyargs)
+                + list(node.args.args)
+                + list(node.args.kwonlyargs)
+            )
+        }
+        if node.args.vararg:
+            params.add(node.args.vararg.arg)
+        if node.args.kwarg:
+            params.add(node.args.kwarg.arg)
+        local_names = set(params)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                local_names.add(sub.id)
+        nested: Set[ast.AST] = set()
+        for sub in ast.walk(node):
+            if sub is not node and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                nested.add(sub)
+                nested.update(ast.walk(sub))
+        for sub in ast.walk(node):
+            if sub in nested:
+                continue  # nested defs are reached (or not) on their own
+            if isinstance(sub, ast.Call):
+                reason = self._impure_call(sub)
+                if reason:
+                    yield Finding(
+                        mod.rel,
+                        sub.lineno,
+                        "TPJ001",
+                        f"{reason} inside jit-reachable "
+                        f"'{info.qualname}' (trace-time side effect)",
+                    )
+            elif isinstance(sub, ast.Attribute):
+                path = dotted_name(sub) or ""
+                if path == "os.environ":
+                    yield Finding(
+                        mod.rel,
+                        sub.lineno,
+                        "TPJ001",
+                        f"os.environ read inside jit-reachable "
+                        f"'{info.qualname}' (trace-time side effect)",
+                    )
+            elif isinstance(sub, (ast.If, ast.While, ast.Assert)):
+                if self._is_string_compare(sub.test):
+                    continue  # comparing to string constants = host config
+                traced = self._traced_test_names(sub.test, local_names)
+                if traced:
+                    names = ", ".join(sorted(traced))
+                    kind = type(sub).__name__.lower()
+                    yield Finding(
+                        mod.rel,
+                        sub.lineno,
+                        "TPJ002",
+                        f"Python-side {kind} on possibly-traced "
+                        f"value(s) {names} in jit-reachable "
+                        f"'{info.qualname}' (use lax.cond/select)",
+                    )
+
+    def _impure_call(self, call: ast.Call) -> Optional[str]:
+        path = dotted_name(call.func) or ""
+        head = path.split(".", 1)[0]
+        if head == "time" and "." in path:
+            return f"{path}() call"
+        if path.startswith(("random.", "np.random.", "numpy.random.")):
+            return f"{path}() call"
+        if path in ("os.urandom", "os.getenv"):
+            return f"{path}() call"
+        if path in ("print", "open", "input"):
+            return f"{path}() call"
+        if head == "tracing" and "." in path:
+            return f"{path}() span"
+        if isinstance(call.func, ast.Attribute):
+            recv = dotted_name(call.func.value) or ""
+            if (
+                call.func.attr in _LOGGER_METHODS
+                and "log" in recv.rsplit(".", 1)[-1].lower()
+            ):
+                return f"logger .{call.func.attr}() call"
+        return None
+
+    def _is_string_compare(self, test: ast.expr) -> bool:
+        """``impl == "mxu"`` / ``impl not in ("vpu", "mxu")``: traced
+        arrays are never strings, so a comparison whose right-hand side
+        is all string constants is host-side configuration."""
+        if not isinstance(test, ast.Compare):
+            return False
+
+        def all_strings(node: ast.expr) -> bool:
+            if isinstance(node, ast.Constant):
+                return isinstance(node.value, str)
+            if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+                return all(all_strings(e) for e in node.elts)
+            return False
+
+        return all(all_strings(c) for c in test.comparators)
+
+    def _traced_test_names(
+        self, test: ast.expr, local_names: Set[str]
+    ) -> Set[str]:
+        """Names of params/locals the test depends on as VALUES (not via
+        static projections like .shape / len() / isinstance())."""
+        parents = parent_map(test)
+        traced: Set[str] = set()
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Name) and node.id in local_names):
+                continue
+            parent = parents.get(node)
+            # x.shape / x.ndim / x.dtype / x.size are static under trace
+            if (
+                isinstance(parent, ast.Attribute)
+                and parent.attr in _SHAPE_ATTRS
+            ):
+                continue
+            # len(x), isinstance(x, T), range(x) ... are static
+            if isinstance(parent, ast.Call):
+                callee = parent.func
+                if (
+                    isinstance(callee, ast.Name)
+                    and callee.id in _STATIC_CALLS
+                    and node in parent.args
+                ):
+                    continue
+                if callee is node:
+                    continue  # calling a local fn, not branching on data
+            traced.add(node.id)
+        return traced
+
+    # --- dtype rule ----------------------------------------------------------
+
+    def _check_dtypes(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and node.attr in _BAD_DTYPES:
+                base = dotted_name(node.value) or ""
+                if base in ("jnp", "np", "jax.numpy", "numpy", "jnp.dtypes"):
+                    yield Finding(
+                        mod.rel,
+                        node.lineno,
+                        "TPJ003",
+                        f"{base}.{node.attr} breaks the uint8/int32/f32 "
+                        "field-kernel dtype discipline",
+                    )
+            elif (
+                isinstance(node, ast.keyword)
+                and node.arg == "dtype"
+                and isinstance(node.value, ast.Constant)
+                and node.value.value in _BAD_DTYPES
+            ):
+                yield Finding(
+                    mod.rel,
+                    node.value.lineno,
+                    "TPJ003",
+                    f"dtype={node.value.value!r} breaks the uint8/int32/f32 "
+                    "field-kernel dtype discipline",
+                )

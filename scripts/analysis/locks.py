@@ -1,0 +1,328 @@
+"""Lock-discipline checker (TPL): ``# guarded-by:`` annotations.
+
+The concurrency-heavy classes (VerifyScheduler, DeviceHealth, the
+caches, verifyd's server) all follow the same convention: shared
+mutable fields are touched only inside ``with self.<lock>:``. The
+convention is invisible to generic linters, so a refactor that hoists
+one read out of the critical section ships silently — exactly the bug
+class the device-policy rewrite fixed by hand. This checker makes the
+convention machine-checked:
+
+Annotation grammar (a comment on the field's assignment line, normally
+in ``__init__``)::
+
+    self._pending = []            # guarded-by: _mtx
+    self._entries = {}            # guarded-by: _lock|_sched_mtx   (either lock)
+    self.flushes = 0              # guarded-by: none(single-writer stats)
+
+Rules:
+
+- TPL001: a guarded field is read or written in a method of the same
+  class outside a ``with`` block holding one of its locks;
+- TPL002: an annotation names a lock attribute the class never assigns;
+- TPL003: a ``guarded-by`` comment sits on a line with no ``self.X``
+  assignment (orphaned — it guards nothing);
+- TPL004: malformed annotation text.
+
+Lock aliasing is understood one level deep: ``self._wake =
+threading.Condition(self._mtx)`` means holding ``_wake`` implies
+holding ``_mtx`` (the scheduler's accumulator pattern). ``__init__`` is
+exempt (no concurrent access before construction completes), as are
+``del`` statements of locals. Nested ``def``s inside a method reset the
+held-lock set — a closure may run on another thread after the lock is
+released — while lambdas/comprehensions (which run inline) inherit it.
+
+Two more conventions from the codebase are honoured: a method whose
+name ends in ``_locked`` is assumed to run with the class's locks
+already held (callers own the critical section), and locks/aliases
+defined in a same-module base class (``_Metric`` -> Counter/Gauge/
+Histogram) are inherited by subclasses before verification.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from scripts.analysis.core import Checker, Finding, Module, dotted_name
+
+GUARD_RE = re.compile(r"guarded-by:\s*(?P<spec>[A-Za-z0-9_|]+(?:\([^)]*\))?)")
+NONE_RE = re.compile(r"^none\((?P<reason>[^)]*)\)$|^none$")
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+def _is_lock_ctor(call: ast.AST) -> bool:
+    """``threading.Lock()`` / ``threading.Condition(...)`` etc."""
+    if not isinstance(call, ast.Call):
+        return False
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_FACTORIES:
+        return True
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_FACTORIES:
+        return True
+    return False
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        #: field name -> (alternative lock names, annotation line), or
+        #: None in place of the set for ``none(...)`` annotations
+        self.guarded: Dict[str, Tuple[Optional[FrozenSet[str]], int]] = {}
+        self.locks: Set[str] = set()
+        #: condition attr -> wrapped lock attr (Condition(self._mtx))
+        self.aliases: Dict[str, str] = {}
+
+
+def _self_assign_targets(stmt: ast.stmt) -> List[str]:
+    """Names X for ``self.X = ...`` / ``self.X: T = ...`` targets."""
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.AugAssign):
+        targets = [stmt.target]
+    out = []
+    for t in targets:
+        if (
+            isinstance(t, ast.Attribute)
+            and isinstance(t.value, ast.Name)
+            and t.value.id == "self"
+        ):
+            out.append(t.attr)
+    return out
+
+
+class LockDisciplineChecker(Checker):
+    name = "locks"
+    codes = {
+        "TPL001": "guarded field accessed outside its lock",
+        "TPL002": "guarded-by names a lock the class never creates",
+        "TPL003": "guarded-by annotation on a line with no self.X assignment",
+        "TPL004": "malformed guarded-by annotation",
+    }
+
+    def check_module(self, module: Module) -> Iterator[Finding]:
+        annotated_lines: Set[int] = set()
+        infos: Dict[str, _ClassInfo] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                infos[node.name] = self._collect(module, node, annotated_lines)
+        # inherit locks/aliases from same-module base classes (the
+        # metrics pattern: _Metric owns _lock, Counter uses it), with a
+        # fixpoint for grandparent chains
+        changed = True
+        while changed:
+            changed = False
+            for info in infos.values():
+                for base in info.node.bases:
+                    if isinstance(base, ast.Name) and base.id in infos:
+                        binfo = infos[base.id]
+                        if not binfo.locks <= info.locks:
+                            info.locks |= binfo.locks
+                            changed = True
+                        for cond, lock in binfo.aliases.items():
+                            if cond not in info.aliases:
+                                info.aliases[cond] = lock
+                                changed = True
+        for info in infos.values():
+            yield from self._verify(module, info)
+        # orphaned annotations: guarded-by comments no class claimed
+        for line, text in module.comments.items():
+            if GUARD_RE.search(text) and line not in annotated_lines:
+                yield Finding(
+                    module.rel,
+                    line,
+                    "TPL003",
+                    "guarded-by annotation does not sit on a "
+                    "self.<field> assignment line",
+                )
+
+    # --- collection ----------------------------------------------------------
+
+    def _collect(
+        self, module: Module, cls: ast.ClassDef, annotated_lines: Set[int]
+    ) -> _ClassInfo:
+        info = _ClassInfo(cls)
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            for attr in _self_assign_targets(node):
+                value = node.value
+                if _is_lock_ctor(value):
+                    info.locks.add(attr)
+                    # Condition(self._mtx): holding the condition holds
+                    # the wrapped lock.
+                    if (
+                        isinstance(value, ast.Call)
+                        and value.args
+                        and isinstance(value.args[0], ast.Attribute)
+                        and isinstance(value.args[0].value, ast.Name)
+                        and value.args[0].value.id == "self"
+                    ):
+                        info.aliases[attr] = value.args[0].attr
+                # annotation on this line?
+                for line in range(node.lineno, node.end_lineno + 1):
+                    m = GUARD_RE.search(module.comment_on(line))
+                    if m:
+                        annotated_lines.add(line)
+                        spec = m.group("spec")
+                        if NONE_RE.match(spec):
+                            info.guarded[attr] = (None, line)
+                        else:
+                            names = frozenset(
+                                s for s in spec.split("|") if s
+                            )
+                            if not names:
+                                continue
+                            info.guarded[attr] = (names, line)
+                        break
+        return info
+
+    # --- verification --------------------------------------------------------
+
+    def _verify(self, module: Module, info: _ClassInfo) -> Iterator[Finding]:
+        # TPL002/TPL004: the annotation itself must be coherent
+        for attr, (locks, line) in sorted(info.guarded.items()):
+            if locks is None:
+                continue
+            for lock in sorted(locks):
+                if lock not in info.locks:
+                    yield Finding(
+                        module.rel,
+                        line,
+                        "TPL002",
+                        f"{info.node.name}.{attr} guarded-by {lock!r}, but "
+                        f"the class never assigns self.{lock} from a "
+                        "threading lock factory",
+                    )
+        checked = {
+            attr: locks
+            for attr, (locks, _) in info.guarded.items()
+            if locks is not None
+        }
+        if not checked:
+            return
+        for item in info.node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if item.name == "__init__":
+                    continue
+                # the repo-wide `_locked` suffix convention: the caller
+                # already holds the class's lock(s) when invoking these
+                held: FrozenSet[str] = (
+                    frozenset(info.locks)
+                    if item.name.endswith("_locked")
+                    else frozenset()
+                )
+                yield from self._walk_fn(module, info, checked, item, held)
+
+    def _expand(self, info: _ClassInfo, held: FrozenSet[str]) -> FrozenSet[str]:
+        """Close the held set over Condition-wraps-lock aliases."""
+        out = set(held)
+        changed = True
+        while changed:
+            changed = False
+            for cond, lock in info.aliases.items():
+                if cond in out and lock not in out:
+                    out.add(lock)
+                    changed = True
+        return frozenset(out)
+
+    def _walk_fn(
+        self,
+        module: Module,
+        info: _ClassInfo,
+        checked: Dict[str, FrozenSet[str]],
+        fn: ast.AST,
+        held: FrozenSet[str],
+    ) -> Iterator[Finding]:
+        body = getattr(fn, "body", [])
+        for stmt in body:
+            yield from self._walk(module, info, checked, stmt, held)
+
+    def _with_locks(self, node: ast.With) -> FrozenSet[str]:
+        names = set()
+        for item in node.items:
+            ctx = item.context_expr
+            if (
+                isinstance(ctx, ast.Attribute)
+                and isinstance(ctx.value, ast.Name)
+                and ctx.value.id == "self"
+            ):
+                names.add(ctx.attr)
+        return frozenset(names)
+
+    def _walk(
+        self,
+        module: Module,
+        info: _ClassInfo,
+        checked: Dict[str, FrozenSet[str]],
+        node: ast.AST,
+        held: FrozenSet[str],
+    ) -> Iterator[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def may outlive the critical section (thread
+            # targets, callbacks): analyze its body with nothing held
+            yield from self._walk_fn(module, info, checked, node, frozenset())
+            return
+        if isinstance(node, ast.With):
+            inner = held | self._with_locks(node)
+            for item in node.items:
+                yield from self._check_expr(
+                    module, info, checked, item.context_expr, held
+                )
+            for stmt in node.body:
+                yield from self._walk(module, info, checked, stmt, inner)
+            return
+        # statements: check embedded expressions, then recurse.
+        # ExceptHandler / match_case carry statement bodies of their own,
+        # so they must go through _walk (a `with` inside an except block
+        # still counts), not be flattened as expressions.
+        stmt_like = (ast.stmt, ast.ExceptHandler)
+        match_case = getattr(ast, "match_case", None)
+        if match_case is not None:
+            stmt_like = stmt_like + (match_case,)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, stmt_like):
+                yield from self._walk(module, info, checked, child, held)
+            else:
+                yield from self._check_expr(
+                    module, info, checked, child, held
+                )
+
+    def _check_expr(
+        self,
+        module: Module,
+        info: _ClassInfo,
+        checked: Dict[str, FrozenSet[str]],
+        expr: ast.AST,
+        held: FrozenSet[str],
+    ) -> Iterator[Finding]:
+        effective = self._expand(info, held)
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._walk_fn(
+                    module, info, checked, node, frozenset()
+                )
+                continue
+            if not (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in checked
+            ):
+                continue
+            locks = checked[node.attr]
+            if not (locks & effective):
+                want = "|".join(sorted(locks))
+                have = ", ".join(sorted(effective)) or "none"
+                yield Finding(
+                    module.rel,
+                    node.lineno,
+                    "TPL001",
+                    f"{info.node.name}.{node.attr} is guarded-by {want} "
+                    f"but accessed holding: {have}",
+                )

@@ -1,0 +1,180 @@
+"""Metrics checkers (TPM): the check_metrics.py audits, in-framework.
+
+- TPM001 — dead instrument: ``self.X = reg.counter|gauge|histogram(...)``
+  declared in ``libs/metrics.py`` but ``.X`` never referenced anywhere
+  else in the package. Dead instruments cost every /metrics scrape and
+  usually mean an instrumentation seam fell off in a refactor.
+- TPM002 — exposition-name hygiene: every instrument's full name must
+  resolve statically (``_name(s, "...")`` with a literal per-class
+  ``s = "<subsystem>"``, or a string literal), match
+  ``tendermint_[a-z0-9_]+``, and be globally unique.
+
+This is a project-level checker (it needs the whole package to find
+references), which is exactly why ``check_metrics.py`` could not stay a
+standalone script once the framework existed: it is now a thin shim over
+these functions so existing invocations and tests keep working.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, Set, Tuple
+
+from scripts.analysis.core import Checker, Finding, Module, Project
+
+_FACTORIES = {"counter", "gauge", "histogram"}
+_NAME_RE = re.compile(r"tendermint_[a-z0-9_]+")
+METRICS_REL = "tendermint_tpu/libs/metrics.py"
+
+
+def declared_instruments(module: Module) -> Dict[str, Tuple[str, int]]:
+    """attr -> (class, lineno) for every instrument declaration."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for cls in ast.walk(module.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                continue
+            call = node.value
+            if not (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr in _FACTORIES
+            ):
+                continue
+            out[tgt.attr] = (cls.name, node.lineno)
+    return out
+
+
+def referenced_attrs(project: Project, skip_rel: str) -> Set[str]:
+    refs: Set[str] = set()
+    for mod in project.modules:
+        if mod.rel == skip_rel:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute):
+                refs.add(node.attr)
+    return refs
+
+
+def name_findings(module: Module) -> Iterator[Finding]:
+    namespace = "tendermint"
+    for node in ast.walk(module.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "NAMESPACE"
+            and isinstance(node.value, ast.Constant)
+        ):
+            namespace = node.value.value
+    names: Dict[str, Tuple[str, int]] = {}
+    for cls in ast.walk(module.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        subsystem = None
+        for node in ast.walk(cls):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "s"
+                and isinstance(node.value, ast.Constant)
+            ):
+                subsystem = node.value.value
+        for node in ast.walk(cls):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _FACTORIES
+                and node.args
+            ):
+                continue
+            arg = node.args[0]
+            full = None
+            if (
+                isinstance(arg, ast.Call)
+                and isinstance(arg.func, ast.Name)
+                and arg.func.id == "_name"
+                and len(arg.args) == 2
+                and isinstance(arg.args[1], ast.Constant)
+            ):
+                if subsystem is None:
+                    yield Finding(
+                        module.rel,
+                        node.lineno,
+                        "TPM002",
+                        f"{cls.name}: _name(s, ...) without a literal "
+                        's = "..." subsystem assignment',
+                    )
+                    continue
+                full = f"{namespace}_{subsystem}_{arg.args[1].value}"
+            elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                full = arg.value
+            else:
+                yield Finding(
+                    module.rel,
+                    node.lineno,
+                    "TPM002",
+                    f"{cls.name}: instrument name is not a static "
+                    '_name(s, "...") or string literal',
+                )
+                continue
+            if not _NAME_RE.fullmatch(full):
+                yield Finding(
+                    module.rel,
+                    node.lineno,
+                    "TPM002",
+                    f"{cls.name}: bad metric name {full!r}",
+                )
+            if full in names:
+                other = names[full]
+                yield Finding(
+                    module.rel,
+                    node.lineno,
+                    "TPM002",
+                    f"{cls.name}: duplicate metric name {full!r} "
+                    f"(also declared at {other[0]}:{other[1]})",
+                )
+            names[full] = (cls.name, node.lineno)
+
+
+class MetricsChecker(Checker):
+    name = "metrics"
+    codes = {
+        "TPM001": "instrument declared but never referenced (dead weight)",
+        "TPM002": "metric exposition-name hygiene violation",
+    }
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        metrics_mod = project.module(METRICS_REL)
+        if metrics_mod is None:
+            return
+        yield from name_findings(metrics_mod)
+        # the dead-instrument audit is only meaningful against the whole
+        # package — on a partial file list every instrument looks dead
+        if not any(
+            not m.rel.startswith("tendermint_tpu/libs/")
+            for m in project.modules
+        ):
+            return
+        decls = declared_instruments(metrics_mod)
+        refs = referenced_attrs(project, metrics_mod.rel)
+        for attr, (cls, lineno) in sorted(decls.items()):
+            if attr not in refs:
+                yield Finding(
+                    metrics_mod.rel,
+                    lineno,
+                    "TPM001",
+                    f"{cls}.{attr} declared but never referenced "
+                    "under tendermint_tpu/",
+                )

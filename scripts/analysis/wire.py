@@ -1,0 +1,306 @@
+"""Wire-compat checker (TPW): proto3 zero-omission hazards.
+
+The verifyd wire format follows proto3 semantics: a varint field whose
+value is 0 is omitted from the encoded message, and the decoder fills
+in 0 for absent fields. That is only safe when 0 means "unset/default".
+The priority-class bug this repo already shipped and fixed by hand was
+exactly the other case — ``CLASS_CONSENSUS = 0`` is a meaningful value,
+so an omitted field silently decoded as consensus priority. The fix was
+a wire shift: encode ``klass + 1``, decode ``raw - 1``. This checker
+makes that reasoning mechanical for ``verifyd/protocol.py`` and
+``libs/grpc.py``:
+
+- TPW001 — a zero-omitted varint field (``if req.attr:`` guard around
+  ``_put_varint``/``_tag``) carries an enum family that HAS a 0-valued
+  member, the value is written unshifted, and the decoder's default for
+  that field is not that 0-member: an encoded 0 round-trips into the
+  wrong value.
+- TPW002 — asymmetric shift: the encoder applies ``+1`` but no decode
+  site applies ``-1`` for the same field (or vice versa) — half a wire
+  shift corrupts every message.
+- TPW003 — grpc-status trailer emitted only when the status is truthy:
+  ``grpc-status: 0`` (OK) must still be sent; a conditional emit makes
+  every success look like a missing status to conforming clients.
+
+Enum families are discovered structurally from the ``X_NAMES =
+{CONST: "name"}`` dicts the protocol modules already maintain, so new
+enums are covered without touching the checker.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from scripts.analysis.core import Checker, Finding, Module, dotted_name, parent_map
+
+_WIRE_FILES = ("verifyd/protocol.py", "libs/grpc.py")
+_EMIT_FNS = {"_put_varint", "_varint", "put_varint", "_tag", "_put_tag"}
+
+
+class _EnumFamily:
+    def __init__(self, name: str):
+        self.name = name  # e.g. "CLASS"
+        self.members: Dict[str, int] = {}
+
+    @property
+    def zero_member(self) -> Optional[str]:
+        for const, val in self.members.items():
+            if val == 0:
+                return const
+        return None
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, ast.USub)
+        and isinstance(node.operand, ast.Constant)
+    ):
+        return -node.operand.value
+    return None
+
+
+class WireCompatChecker(Checker):
+    name = "wire"
+    codes = {
+        "TPW001": "zero-omitted enum field where 0 is meaningful and unshifted",
+        "TPW002": "asymmetric +1/-1 wire shift between encode and decode",
+        "TPW003": "grpc-status trailer emitted conditionally on truthiness",
+    }
+
+    def check_module(self, module: Module) -> Iterator[Finding]:
+        if not any(module.rel.endswith(w) for w in _WIRE_FILES):
+            return
+        families = self._enum_families(module)
+        consts = self._int_consts(module)
+        yield from self._check_zero_omission(module, families, consts)
+        yield from self._check_shift_symmetry(module, families)
+        yield from self._check_grpc_status(module)
+
+    # --- enum discovery ------------------------------------------------------
+
+    def _enum_families(self, module: Module) -> List[_EnumFamily]:
+        """Families from ``X_NAMES = {CONST: "name"}`` module dicts."""
+        consts = self._int_consts(module)
+        fams: List[_EnumFamily] = []
+        for node in module.tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            t = node.targets[0]
+            if not (isinstance(t, ast.Name) and t.id.endswith("_NAMES")):
+                continue
+            if not isinstance(node.value, ast.Dict):
+                continue
+            fam = _EnumFamily(t.id[: -len("_NAMES")])
+            for key in node.value.keys:
+                if isinstance(key, ast.Name) and key.id in consts:
+                    fam.members[key.id] = consts[key.id]
+            if fam.members:
+                fams.append(fam)
+        return fams
+
+    def _int_consts(self, module: Module) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                val = _const_int(node.value)
+                if isinstance(t, ast.Name) and val is not None:
+                    out[t.id] = val
+        return out
+
+    # --- TPW001: zero omission ----------------------------------------------
+
+    def _field_of_emit(self, call: ast.Call) -> Optional[Tuple[str, ast.AST]]:
+        """(attr name, value expr) for an emit of ``x.attr``-derived data."""
+        fn = dotted_name(call.func) or ""
+        if fn.rsplit(".", 1)[-1] not in _EMIT_FNS:
+            return None
+        for arg in call.args:
+            inner = arg
+            shift = 0
+            if isinstance(inner, ast.BinOp) and isinstance(
+                inner.op, (ast.Add, ast.Sub)
+            ):
+                if _const_int(inner.right) is not None:
+                    shift = _const_int(inner.right)
+                    inner = inner.left
+            if isinstance(inner, ast.Attribute) and isinstance(
+                inner.value, ast.Name
+            ):
+                return (inner.attr, arg) if shift == 0 else None
+        return None
+
+    def _enum_for_attr(
+        self, attr: str, families: List[_EnumFamily]
+    ) -> Optional[_EnumFamily]:
+        # req.klass -> CLASS, req.algo -> ALGO, req.status -> STATUS, ...
+        special = {"klass": "CLASS", "kind": "KIND"}
+        want = special.get(attr, attr.upper())
+        for fam in families:
+            if fam.name == want:
+                return fam
+        return None
+
+    def _decode_default(self, module: Module, attr: str) -> Optional[str]:
+        """CONST name used as the decode-side default for ``attr``.
+
+        Matches ``attr = SOME_CONST`` statements (the decoder's
+        pre-loop defaults) and ``Foo(..., attr or DEFAULT ...)`` calls.
+        """
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == attr:
+                        if isinstance(node.value, ast.Name):
+                            return node.value.id
+        return None
+
+    def _check_zero_omission(
+        self,
+        module: Module,
+        families: List[_EnumFamily],
+        consts: Dict[str, int],
+    ) -> Iterator[Finding]:
+        parents = parent_map(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = self._field_of_emit(node)
+            if hit is None:
+                continue
+            attr, _ = hit
+            fam = self._enum_for_attr(attr, families)
+            if fam is None or fam.zero_member is None:
+                continue
+            # zero-omitted? — look for an enclosing `if x.attr:` truthiness
+            # guard around this emit.
+            guarded = False
+            cur: Optional[ast.AST] = node
+            while cur is not None:
+                cur = parents.get(cur)
+                if isinstance(cur, ast.If):
+                    test = cur.test
+                    if (
+                        isinstance(test, ast.Attribute)
+                        and test.attr == attr
+                    ):
+                        guarded = True
+                        break
+            if not guarded:
+                continue
+            default = self._decode_default(module, attr)
+            if default == fam.zero_member:
+                continue  # omitted 0 decodes back to the same 0-member: safe
+            yield Finding(
+                module.rel,
+                node.lineno,
+                "TPW001",
+                f"field '{attr}' is zero-omitted and unshifted, but "
+                f"{fam.zero_member}=0 is a meaningful {fam.name} value "
+                f"and the decode default is {default or 'unknown'}; "
+                "wire-shift it (+1 encode / -1 decode)",
+            )
+
+    # --- TPW002: shift symmetry ----------------------------------------------
+
+    def _shift_sites(
+        self, module: Module, families: List[_EnumFamily]
+    ) -> Dict[str, Dict[str, int]]:
+        """attr -> {direction: first lineno}; directions are enc±1/dec±1.
+
+        Encode side: ``<x>.attr ± 1`` used as a value (the emit path).
+        Decode side: ``<x>.attr = <expr> ± 1`` / ``raw_attr``-named
+        assignments (the parse path). Only attrs belonging to a
+        discovered enum family count — shifts only matter where 0 is an
+        enum member, and anything else (HPACK indices, length maths)
+        is ordinary arithmetic.
+        """
+        out: Dict[str, Dict[str, int]] = {}
+
+        def note(attr: str, direction: str, line: int) -> None:
+            out.setdefault(attr, {}).setdefault(direction, line)
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.BinOp):
+                op = node.value.op
+                if isinstance(op, (ast.Add, ast.Sub)) and _const_int(
+                    node.value.right
+                ) == 1:
+                    sign = "+" if isinstance(op, ast.Add) else "-"
+                    for t in node.targets:
+                        attr = None
+                        if isinstance(t, ast.Attribute):
+                            attr = t.attr
+                        elif isinstance(t, ast.Name) and t.id.startswith("raw_"):
+                            attr = t.id[4:]
+                        if attr and self._enum_for_attr(attr, families):
+                            note(attr, f"dec{sign}1", node.lineno)
+            elif (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, (ast.Add, ast.Sub))
+                and _const_int(node.right) == 1
+                and isinstance(node.left, ast.Attribute)
+            ):
+                attr = node.left.attr
+                if self._enum_for_attr(attr, families):
+                    sign = "+" if isinstance(node.op, ast.Add) else "-"
+                    note(attr, f"enc{sign}1", node.lineno)
+        return out
+
+    def _check_shift_symmetry(
+        self, module: Module, families: List[_EnumFamily]
+    ) -> Iterator[Finding]:
+        for attr, dirs in sorted(self._shift_sites(module, families).items()):
+            enc = {d for d in dirs if d.startswith("enc")}
+            dec = {d for d in dirs if d.startswith("dec")}
+            line = min(dirs.values())
+            what = None
+            if "enc+1" in dirs and "dec-1" not in dirs:
+                what = "encoded +1 but never decoded -1"
+            elif "dec-1" in dirs and "enc+1" not in dirs:
+                what = "decoded -1 but never encoded +1"
+            elif "enc-1" in enc or "dec+1" in dec:
+                what = "shift signs point the same direction on both sides"
+            if what:
+                yield Finding(
+                    module.rel,
+                    line,
+                    "TPW002",
+                    f"wire shift for '{attr}' is asymmetric: {what}; "
+                    "every message will round-trip corrupted",
+                )
+
+    # --- TPW003: grpc-status trailer ------------------------------------------
+
+    def _check_grpc_status(self, module: Module) -> Iterator[Finding]:
+        parents = parent_map(module.tree)
+        for node in ast.walk(module.tree):
+            if not (
+                isinstance(node, ast.Constant)
+                and node.value == "grpc-status"
+            ):
+                continue
+            cur: Optional[ast.AST] = node
+            while cur is not None:
+                cur = parents.get(cur)
+                if isinstance(cur, ast.If):
+                    test = cur.test
+                    # `if status:` / `if code:` truthiness (0 == OK is falsy)
+                    if isinstance(test, (ast.Name, ast.Attribute)):
+                        name = (dotted_name(test) or "").rsplit(".", 1)[-1]
+                        if "status" in name or name == "code":
+                            yield Finding(
+                                module.rel,
+                                node.lineno,
+                                "TPW003",
+                                "grpc-status trailer emitted only when the "
+                                "status is truthy; status 0 (OK) must "
+                                "still be sent",
+                            )
+                    break
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break

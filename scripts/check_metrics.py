@@ -1,27 +1,16 @@
 #!/usr/bin/env python3
-"""Static audit: every instrument declared in libs/metrics.py is used.
+"""Thin shim over the tpulint metrics checkers (scripts/analysis).
 
-Walks the metrics-class declarations (``self.X = reg.counter|gauge|
-histogram(...)``) with the ast module, then greps the package source for
-``.X`` attribute references outside the declaration site. A declared-but-
-never-referenced instrument is dead weight on every /metrics scrape and
-usually means an instrumentation seam silently fell off in a refactor —
-this script makes that a CI failure instead of a dashboard mystery.
-
-A second pass audits exposition-name hygiene: every instrument's full
-name must resolve statically (the ``_name(s, "...")`` convention with a
-literal ``s = "<subsystem>"`` per class), match ``tendermint_[a-z0-9_]+``,
-and be globally unique — so a new subsystem (e.g. verifyd) cannot
-silently collide with or misname an existing series.
-
-Usage: python scripts/check_metrics.py  (exit 0 clean, 1 on dead
-instruments or name-hygiene violations; also asserted by
-tests/test_metrics.py and run by scripts/ci_checks.sh).
+The dead-instrument and exposition-name audits now live in
+``scripts/analysis/metrics_checks.py`` (codes TPM001/TPM002) so they
+run with the rest of the static-analysis suite; this script keeps the
+historical entry point (``python scripts/check_metrics.py``, used by
+ci_checks.sh and loaded by file path in tests/test_metrics.py) working
+with the same public functions and exit-code contract.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
@@ -29,139 +18,47 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO, "tendermint_tpu")
 METRICS_PY = os.path.join(PACKAGE, "libs", "metrics.py")
 
-_FACTORIES = {"counter", "gauge", "histogram"}
+# this file is also loaded by path (importlib.spec_from_file_location),
+# where the scripts package is not importable without the repo root
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from scripts.analysis import metrics_checks as _mc  # noqa: E402
+from scripts.analysis.core import Module, Project, load_modules  # noqa: E402
+
+
+def _load(path: str) -> Module:
+    with open(path, "r") as fh:
+        return Module(path, fh.read(), rel=os.path.relpath(path, REPO))
 
 
 def declared_instruments(path: str = METRICS_PY) -> dict:
     """Map attribute name -> (class, lineno) for every ``self.X =
     reg.counter|gauge|histogram(...)`` assignment."""
-    with open(path, "r") as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    out = {}
-    for cls in ast.walk(tree):
-        if not isinstance(cls, ast.ClassDef):
-            continue
-        for node in ast.walk(cls):
-            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
-                continue
-            tgt = node.targets[0]
-            if not (
-                isinstance(tgt, ast.Attribute)
-                and isinstance(tgt.value, ast.Name)
-                and tgt.value.id == "self"
-            ):
-                continue
-            call = node.value
-            if not (
-                isinstance(call, ast.Call)
-                and isinstance(call.func, ast.Attribute)
-                and call.func.attr in _FACTORIES
-            ):
-                continue
-            out[tgt.attr] = (cls.name, node.lineno)
-    return out
+    return _mc.declared_instruments(_load(path))
 
 
 def referenced_attrs(root: str = PACKAGE, skip: str = METRICS_PY) -> set:
     """Attribute names referenced as ``.X`` anywhere under ``root``
     except the declaration file itself."""
-    refs = set()
-    for dirpath, _dirnames, filenames in os.walk(root):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            if os.path.abspath(path) == os.path.abspath(skip):
-                continue
-            with open(path, "r") as fh:
-                try:
-                    tree = ast.parse(fh.read(), filename=path)
-                except SyntaxError:
-                    continue
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Attribute):
-                    refs.add(node.attr)
-    return refs
+    modules = load_modules([root], repo_root=REPO)
+    skip_rel = os.path.relpath(os.path.abspath(skip), REPO).replace(
+        os.sep, "/"
+    )
+    return _mc.referenced_attrs(Project(modules), skip_rel)
 
 
 def declared_names(path: str = METRICS_PY) -> dict:
-    """Map full exposition name -> (class, lineno) for every instrument,
-    resolving the ``_name(s, "...")`` convention: each metrics class
-    assigns ``s = "<subsystem>"`` once and every factory call must pass
-    ``_name(s, "<literal>")`` so the full name is statically known."""
-    import re
-
-    with open(path, "r") as fh:
-        src = fh.read()
-    tree = ast.parse(src, filename=path)
-    namespace = "tendermint"
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Assign)
-            and len(node.targets) == 1
-            and isinstance(node.targets[0], ast.Name)
-            and node.targets[0].id == "NAMESPACE"
-            and isinstance(node.value, ast.Constant)
-        ):
-            namespace = node.value.value
-    problems = []
-    names = {}
-    for cls in ast.walk(tree):
-        if not isinstance(cls, ast.ClassDef):
-            continue
-        subsystem = None
-        for node in ast.walk(cls):
-            if (
-                isinstance(node, ast.Assign)
-                and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)
-                and node.targets[0].id == "s"
-                and isinstance(node.value, ast.Constant)
-            ):
-                subsystem = node.value.value
-        for node in ast.walk(cls):
-            if not (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in _FACTORIES
-                and node.args
-            ):
-                continue
-            arg = node.args[0]
-            full = None
-            if (
-                isinstance(arg, ast.Call)
-                and isinstance(arg.func, ast.Name)
-                and arg.func.id == "_name"
-                and len(arg.args) == 2
-                and isinstance(arg.args[1], ast.Constant)
-            ):
-                if subsystem is None:
-                    problems.append(
-                        f"{cls.name}:{node.lineno}: _name(s, ...) without a"
-                        f" literal `s = \"...\"` subsystem assignment"
-                    )
-                    continue
-                full = f"{namespace}_{subsystem}_{arg.args[1].value}"
-            elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-                full = arg.value
-            else:
-                problems.append(
-                    f"{cls.name}:{node.lineno}: instrument name is not a"
-                    f" static _name(s, \"...\") or string literal"
-                )
-                continue
-            if not re.fullmatch(r"tendermint_[a-z0-9_]+", full):
-                problems.append(
-                    f"{cls.name}:{node.lineno}: bad metric name {full!r}"
-                )
-            if full in names:
-                other = names[full]
-                problems.append(
-                    f"{cls.name}:{node.lineno}: duplicate metric name"
-                    f" {full!r} (also declared at {other[0]}:{other[1]})"
-                )
-            names[full] = (cls.name, node.lineno)
+    """{"names": {full name -> (class, lineno)}, "problems": [str]} —
+    the historical shape, rebuilt from TPM002 findings."""
+    mod = _load(path)
+    problems = [
+        f"{f.path}:{f.line}: {f.message}" for f in _mc.name_findings(mod)
+    ]
+    # names map, recomputed the cheap way (problems already reported)
+    names: dict = {}
+    for attr, (cls, lineno) in _mc.declared_instruments(mod).items():
+        names.setdefault(attr, (cls, lineno))
     return {"names": names, "problems": problems}
 
 

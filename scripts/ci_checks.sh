@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# Repo CI gate: byte-compile, static metrics audit, tier-1 tests.
+# Repo CI gate: byte-compile, static analysis, sanitizer-enabled
+# concurrency tests, metrics audit, tier-1 tests.
 #
 # The tier-1 line is the ROADMAP.md "Tier-1 verify" command verbatim —
 # keep the two in sync. DOTS_PASSED is the per-test pass count the
@@ -10,6 +11,29 @@ rc_total=0
 
 echo "== compileall =="
 python -m compileall -q tendermint_tpu tests scripts bench.py || rc_total=1
+
+echo "== analysis (tpulint) =="
+# project-specific static analysis: lock discipline, JAX purity,
+# wire compat, hygiene, metrics. New findings (not in the committed
+# baseline) fail the gate.
+python -m scripts.analysis || rc_total=1
+
+echo "== sanitizer-enabled concurrency tests =="
+# the lock-order sanitizer records the acquisition-order graph while
+# the concurrency-heavy modules run their tests; an AB/BA inversion
+# prints a LOCK-ORDER CYCLE marker even when no run deadlocks.
+rm -f /tmp/_sanitize.log
+timeout -k 10 600 env TENDERMINT_TPU_SANITIZE=1 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_scheduler.py tests/test_verifyd.py \
+    tests/test_device_policy.py -q -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_sanitize.log
+[ "${PIPESTATUS[0]}" -ne 0 ] && rc_total=1
+if grep -q "LOCK-ORDER CYCLE" /tmp/_sanitize.log; then
+    echo "sanitizer: lock-order cycle detected (potential deadlock)" >&2
+    rc_total=1
+fi
+# IO-UNDER-LOCK lines in the log are report-only: the grpc client
+# deliberately holds its connection mutex across a unary call.
 
 echo "== check_metrics =="
 python scripts/check_metrics.py || rc_total=1

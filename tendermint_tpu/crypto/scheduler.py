@@ -114,19 +114,20 @@ class VerifyScheduler:
         # explicit wire rejection.
         self.max_pending = max_pending
         self._on_flush = on_flush
-        self._pending: List[_Pending] = []
+        self._pending: List[_Pending] = []  # guarded-by: _mtx
         self._mtx = threading.Lock()
         self._wake = threading.Condition(self._mtx)
-        self._stop = False
-        self._thread: Optional[threading.Thread] = None
-        # observability
-        self.flushes = 0
-        self.entries_verified = 0
-        self.entries_coalesced = 0  # duplicate submissions answered by one lane
-        self.flush_errors = 0  # primary verify_fn raised
-        self.fallback_flushes = 0  # fallback_fn answered a failed flush
-        self.submit_rejections = 0  # submits refused by max_pending
-        self.flush_reasons = {"size": 0, "deadline": 0, "shutdown": 0}
+        self._stop = False  # guarded-by: _mtx
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _mtx
+        # observability — single-writer: only the accumulator thread (and
+        # post-join stop()) mutate these; racy reads are stats-grade.
+        self.flushes = 0  # guarded-by: none(single-writer stats)
+        self.entries_verified = 0  # guarded-by: none(single-writer stats)
+        self.entries_coalesced = 0  # guarded-by: none(single-writer stats)
+        self.flush_errors = 0  # guarded-by: none(single-writer stats)
+        self.fallback_flushes = 0  # guarded-by: none(single-writer stats)
+        self.submit_rejections = 0  # guarded-by: none(single-writer stats)
+        self.flush_reasons = {"size": 0, "deadline": 0, "shutdown": 0}  # guarded-by: none(single-writer stats)
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -145,9 +146,11 @@ class VerifyScheduler:
         with self._wake:
             self._stop = True
             self._wake.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+            # snapshot under the lock (a concurrent start() may race us);
+            # join OUTSIDE it — the accumulator needs _mtx to drain.
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5)
         # fail any stragglers closed rather than hanging their callers
         with self._mtx:
             leftovers, self._pending = self._pending, []

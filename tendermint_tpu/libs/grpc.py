@@ -46,6 +46,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from tendermint_tpu.libs import log
+
 # --- frame types / flags ----------------------------------------------------
 
 FRAME_DATA = 0x0
@@ -474,7 +476,7 @@ class GrpcChannel:
                     )
                 self._conn.sock.close()
             except OSError:
-                pass
+                pass  # best-effort GOAWAY/close on teardown
             self._conn = None
 
     def _connect_locked(self) -> _ConnState:
@@ -612,8 +614,9 @@ class GrpcServer:
     unknown paths UNIMPLEMENTED (grpc_server.go:83 shape)."""
 
     def __init__(self, handlers: Dict[str, Handler], host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, logger=None):
         self._handlers = handlers
+        self._logger = logger if logger is not None else log.NOP_LOGGER
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         # Bind eagerly (SocketServer does the same) so `address` is
@@ -641,7 +644,7 @@ class GrpcServer:
             try:
                 self._lsock.close()
             except OSError:
-                pass
+                pass  # listener may already be closed; stop() is idempotent
             self._lsock = None
         for t in self._threads:
             t.join(timeout=2)
@@ -753,13 +756,27 @@ class GrpcServer:
                         self._dispatch(conn, s, dict(hdrs), bytes(body))
                     finally:
                         conn.close_stream(s)
-        except (H2ProtocolError, OSError, GrpcError):
-            pass
+        except (H2ProtocolError, OSError, GrpcError) as exc:
+            # A misbehaving or vanished peer ends its own connection
+            # thread; the server and every other connection keep serving.
+            peer = "?"
+            try:
+                # AF_INET returns a (host, port) tuple; AF_UNIX a path str
+                name = sock.getpeername()
+                peer = "%s:%s" % name[:2] if isinstance(name, tuple) else str(name)
+            except OSError:
+                pass  # peer already gone; log with the placeholder
+            self._logger.debug(
+                "grpc connection closed",
+                peer=peer,
+                error=type(exc).__name__,
+                detail=str(exc),
+            )
         finally:
             try:
                 sock.close()
             except OSError:
-                pass
+                pass  # best-effort close of an already-dead socket
 
     def _dispatch(
         self, conn: _ConnState, stream_id: int, headers: Dict[str, str],

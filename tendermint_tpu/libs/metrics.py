@@ -69,7 +69,7 @@ class Counter(_Metric):
 
     def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
         super().__init__(name, help_, label_names)
-        self._values: Dict[Tuple, float] = {}
+        self._values: Dict[Tuple, float] = {}  # guarded-by: _lock
 
     def labels(self, **labels: str) -> "_BoundCounter":
         return _BoundCounter(self, _label_key(labels))
@@ -110,7 +110,7 @@ class Gauge(_Metric):
 
     def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
         super().__init__(name, help_, label_names)
-        self._values: Dict[Tuple, float] = {}
+        self._values: Dict[Tuple, float] = {}  # guarded-by: _lock
 
     def labels(self, **labels: str) -> "_BoundGauge":
         return _BoundGauge(self, _label_key(labels))
@@ -168,7 +168,7 @@ class Histogram(_Metric):
         super().__init__(name, help_, label_names)
         self.buckets = tuple(sorted(buckets))
         # per label key: (bucket counts, sum, count)
-        self._values: Dict[Tuple, Tuple[List[int], float, int]] = {}
+        self._values: Dict[Tuple, Tuple[List[int], float, int]] = {}  # guarded-by: _lock
 
     def labels(self, **labels: str) -> "_BoundHistogram":
         return _BoundHistogram(self, _label_key(labels))
@@ -228,7 +228,7 @@ class Registry:
     """Collects metrics and renders the text exposition format."""
 
     def __init__(self):
-        self._metrics: List[_Metric] = []
+        self._metrics: List[_Metric] = []  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def register(self, metric: _Metric) -> _Metric:

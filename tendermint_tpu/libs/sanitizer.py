@@ -1,0 +1,363 @@
+"""Runtime lock-order sanitizer: the dynamic half of tpulint.
+
+The static lock checker proves fields are touched under their lock; it
+cannot see the ORDER locks nest in across threads. A consistent global
+order is deadlock-free; an AB/BA inversion between two threads is a
+deadlock waiting for the right interleaving — the kind of bug that
+survives every test run until it takes down a validator. This module
+finds inversions without needing the deadlock to actually happen:
+
+When ``install()`` runs (or ``TENDERMINT_TPU_SANITIZE=1`` at conftest
+import), ``threading.Lock``/``threading.RLock`` are replaced by a
+wrapper that keeps a per-thread stack of held locks and records, on
+every acquisition, an edge from each held lock to the new one in a
+process-wide acquisition-order graph. Nodes are lock *creation sites*
+(``file:line`` of the constructor call), so the thousands of per-metric
+lock instances collapse into one node per class of lock. A cycle in
+that graph is a potential deadlock even if no run ever deadlocked.
+
+Also recorded, report-only: blocking IO (``time.sleep``,
+``socket.recv``/``accept``) entered while holding a sanitized lock.
+That is sometimes deliberate — the grpc client serializes whole calls
+under its connection mutex by design — so IO-under-lock findings are
+surfaced for review but do not fail CI; cycles do (ci_checks.sh greps
+for the ``LOCK-ORDER CYCLE`` marker).
+
+Overhead is a dict update per acquisition — fine for tests, not for
+production; this is a test-harness tool, which is why it activates only
+via explicit env/install and never by import side effect.
+"""
+
+from __future__ import annotations
+
+import _thread
+import os
+import socket
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+ENV = "TENDERMINT_TPU_SANITIZE"
+
+# internal bookkeeping uses raw OS locks so the sanitizer never records
+# (or deadlocks on) itself
+_state_mtx = _thread.allocate_lock()
+_tls = threading.local()
+
+_installed = False
+_orig_lock = None
+_orig_rlock = None
+_orig_sleep = None
+_orig_recv = None
+_orig_accept = None
+
+#: (from_site, to_site) -> example (thread name, to-site acquire stack)
+_edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+#: (io kind, frozenset of held sites) -> example thread name
+_io_violations: Dict[Tuple[str, Tuple[str, ...]], str] = {}
+_known_sites: Set[str] = set()
+
+
+def enabled_from_env() -> bool:
+    return os.environ.get(ENV, "") not in ("", "0", "false", "no")
+
+
+def _caller_site() -> str:
+    """file:line of the lock constructor call, skipping sanitizer and
+    threading internals (a Condition() allocates its RLock inside
+    threading.py — the interesting site is Condition's caller)."""
+    f = sys._getframe(2)
+    here = os.path.dirname(os.path.abspath(__file__))
+    while f is not None:
+        fn = f.f_code.co_filename
+        if (
+            os.path.abspath(fn) != os.path.abspath(__file__)
+            and os.sep + "threading.py" not in fn
+        ):
+            try:
+                rel = os.path.relpath(fn)
+            except ValueError:
+                rel = fn
+            if not rel.startswith(".."):
+                fn = rel
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _held_stack() -> List["_SanitizedLock"]:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+class _SanitizedLock:
+    """Wraps a raw Lock/RLock; speaks both the lock protocol and the
+    pieces of the RLock protocol that threading.Condition wants."""
+
+    def __init__(self, inner: Any, site: str, reentrant: bool):
+        self._inner = inner
+        self._site = site
+        self._reentrant = reentrant
+        with _state_mtx:
+            _known_sites.add(site)
+
+    # --- bookkeeping ---------------------------------------------------------
+
+    def _depth(self) -> int:
+        return sum(1 for l in _held_stack() if l is self)
+
+    def _note_acquired(self) -> None:
+        stack = _held_stack()
+        if self._reentrant and self._depth() > 0:
+            stack.append(self)  # reentrant re-acquire: no new edges
+            return
+        held_sites = []
+        for l in stack:
+            if l._site != self._site and l._site not in held_sites:
+                held_sites.append(l._site)
+        if held_sites:
+            who = threading.current_thread().name
+            try:
+                frame = sys._getframe(3)
+            except ValueError:
+                frame = None
+            where = "".join(traceback.format_stack(frame, limit=4))
+            with _state_mtx:
+                for s in held_sites:
+                    _edges.setdefault((s, self._site), (who, where))
+        stack.append(self)
+
+    def _note_released(self) -> None:
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                return
+
+    # --- lock protocol -------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._note_acquired()
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._note_released()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        kind = "RLock" if self._reentrant else "Lock"
+        return f"<sanitized {kind} from {self._site}>"
+
+    # --- Condition protocol (used by threading.Condition) --------------------
+
+    def _release_save(self):
+        self._note_released()
+        if self._reentrant:
+            # fully release an N-deep RLock; Condition restores it after
+            depth = self._depth() + 1  # +1: _note_released popped one
+            while self._depth() > 0:
+                self._note_released()
+            if hasattr(self._inner, "_release_save"):
+                return (self._inner._release_save(), depth)
+            self._inner.release()
+            return (None, depth)
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        if self._reentrant:
+            inner_state, depth = state
+            if hasattr(self._inner, "_acquire_restore"):
+                self._inner._acquire_restore(inner_state)
+            else:
+                self._inner.acquire()
+            for _ in range(depth):
+                self._note_acquired()
+        else:
+            self._inner.acquire()
+            self._note_acquired()
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        # plain Lock: same approximation threading.Condition uses
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+
+def _make_lock():
+    return _SanitizedLock(_orig_lock(), _caller_site(), reentrant=False)
+
+
+def _make_rlock():
+    return _SanitizedLock(_orig_rlock(), _caller_site(), reentrant=True)
+
+
+# --- IO-under-lock probes -----------------------------------------------------
+
+
+def _note_io(kind: str) -> None:
+    stack = getattr(_tls, "held", None)
+    if not stack:
+        return
+    sites = tuple(sorted({l._site for l in stack}))
+    who = threading.current_thread().name
+    with _state_mtx:
+        _io_violations.setdefault((kind, sites), who)
+
+
+def _sleep(seconds: float) -> None:
+    _note_io("time.sleep")
+    _orig_sleep(seconds)
+
+
+def _recv(self, *args, **kwargs):
+    _note_io("socket.recv")
+    return _orig_recv(self, *args, **kwargs)
+
+
+def _accept(self, *args, **kwargs):
+    _note_io("socket.accept")
+    return _orig_accept(self, *args, **kwargs)
+
+
+# --- install / report ---------------------------------------------------------
+
+
+def install() -> None:
+    """Patch the lock factories and IO probes. Idempotent. Only locks
+    created AFTER install are sanitized — install before importing the
+    code under test (tests/conftest.py does)."""
+    global _installed, _orig_lock, _orig_rlock
+    global _orig_sleep, _orig_recv, _orig_accept
+    if _installed:
+        return
+    _orig_lock = threading.Lock
+    _orig_rlock = threading.RLock
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    _orig_sleep = time.sleep
+    time.sleep = _sleep
+    _orig_recv = socket.socket.recv
+    socket.socket.recv = _recv
+    _orig_accept = socket.socket.accept
+    socket.socket.accept = _accept
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _orig_lock
+    threading.RLock = _orig_rlock
+    time.sleep = _orig_sleep
+    socket.socket.recv = _orig_recv
+    socket.socket.accept = _orig_accept
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    """Drop recorded edges/violations (test isolation)."""
+    with _state_mtx:
+        _edges.clear()
+        _io_violations.clear()
+        _known_sites.clear()
+
+
+def _find_cycles(
+    edges: Dict[Tuple[str, str], Tuple[str, str]]
+) -> List[List[str]]:
+    """Elementary cycles in the site graph (one representative path per
+    strongly-connected component with a cycle). Self-edges are excluded
+    at record time, so every reported cycle spans >= 2 sites."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+
+    def dfs(node: str, path: List[str]) -> None:
+        color[node] = GREY
+        path.append(node)
+        for nxt in sorted(graph[node]):
+            if color[nxt] == GREY:
+                i = path.index(nxt)
+                cyc = path[i:]
+                canon = tuple(sorted(cyc))
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(cyc + [nxt])
+            elif color[nxt] == WHITE:
+                dfs(nxt, path)
+        path.pop()
+        color[node] = BLACK
+
+    for node in sorted(graph):
+        if color[node] == WHITE:
+            dfs(node, [])
+    return cycles
+
+
+def report() -> Dict[str, Any]:
+    """Snapshot of findings: ``{"cycles": [...], "io_under_lock": [...],
+    "edges": N, "sites": N}``."""
+    with _state_mtx:
+        edges = dict(_edges)
+        io = dict(_io_violations)
+        nsites = len(_known_sites)
+    cycles = _find_cycles(edges)
+    return {
+        "cycles": cycles,
+        "io_under_lock": [
+            {"io": kind, "held": list(sites), "thread": who}
+            for (kind, sites), who in sorted(io.items())
+        ],
+        "edges": len(edges),
+        "sites": nsites,
+    }
+
+
+def print_report(stream=None) -> int:
+    """Human report; returns the number of cycles (CI fails on > 0).
+    The ``LOCK-ORDER CYCLE`` marker is the grep target for CI."""
+    out = stream if stream is not None else sys.stderr
+    snap = report()
+    for cyc in snap["cycles"]:
+        out.write("LOCK-ORDER CYCLE: " + " -> ".join(cyc) + "\n")
+    for v in snap["io_under_lock"]:
+        out.write(
+            "IO-UNDER-LOCK (report-only): %s while holding [%s] in %s\n"
+            % (v["io"], ", ".join(v["held"]), v["thread"])
+        )
+    if not snap["cycles"] and not snap["io_under_lock"]:
+        out.write(
+            "sanitizer: no lock-order cycles "
+            f"({snap['sites']} lock sites, {snap['edges']} order edges)\n"
+        )
+    return len(snap["cycles"])

@@ -112,17 +112,20 @@ class Tracer:
     def __init__(self, cap: int = DEFAULT_CAP):
         self._lock = threading.Lock()
         self._tls = threading.local()
-        self._ring: deque = deque(maxlen=cap)
-        self._mode = OFF
-        self._path: Optional[str] = None
-        self._recording = False
-        self._observer: Optional[Callable[[str, Dict[str, Any], float], None]] = None
+        self._ring: deque = deque(maxlen=cap)  # guarded-by: _lock
+        # mode/path/recording/observer are written under _lock but read
+        # racily on the hot path: a span started mid-configure() may land
+        # in the old or new mode, which is fine for a tracer.
+        self._mode = OFF  # guarded-by: none(racy hot-path read, see above)
+        self._path: Optional[str] = None  # guarded-by: none(racy hot-path read)
+        self._recording = False  # guarded-by: none(racy hot-path read)
+        self._observer: Optional[Callable[[str, Dict[str, Any], float], None]] = None  # guarded-by: none(racy hot-path read)
         self._epoch = time.perf_counter()
         self._pid = os.getpid()
-        self._thread_names: Dict[int, str] = {}
-        self._atexit_registered = False
-        self.recorded = 0  # completed spans+instants accepted into the ring
-        self.dropped = 0  # evicted by the ring bound
+        self._thread_names: Dict[int, str] = {}  # guarded-by: _lock
+        self._atexit_registered = False  # guarded-by: _lock
+        self.recorded = 0  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
 
     # --- configuration -------------------------------------------------------
 
@@ -136,7 +139,7 @@ class Tracer:
         try:
             cap = max(1, int(os.environ.get(CAP_ENV, DEFAULT_CAP)))
         except ValueError:
-            pass
+            pass  # unparseable env override keeps the default cap
         with self._lock:
             self._mode = mode
             self._path = None if mode in (OFF, RING) else mode

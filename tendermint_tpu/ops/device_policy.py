@@ -135,16 +135,16 @@ class DeviceHealth:
         self.retry_budget = retry_budget
         self.cooldown_base = cooldown_base
         self.cooldown_max = cooldown_max
-        self._state = HEALTHY
-        self._consecutive_failures = 0
-        self._cooldown = cooldown_base  # next cooldown duration
-        self._cooldown_until = 0.0
-        self._probe_inflight = False
+        self._state = HEALTHY  # guarded-by: _mtx
+        self._consecutive_failures = 0  # guarded-by: _mtx
+        self._cooldown = cooldown_base  # next cooldown duration  # guarded-by: _mtx
+        self._cooldown_until = 0.0  # guarded-by: _mtx
+        self._probe_inflight = False  # guarded-by: _mtx
         # observability (all monotone; tests read these directly)
-        self.transitions: List[Tuple[str, str]] = []
-        self.fallback_batches = 0
-        self.failure_counts = {TRANSIENT: 0, PERMANENT: 0}
-        self._metrics = None  # OpsMetrics, bound by the node
+        self.transitions: List[Tuple[str, str]] = []  # guarded-by: _mtx
+        self.fallback_batches = 0  # guarded-by: _mtx
+        self.failure_counts = {TRANSIENT: 0, PERMANENT: 0}  # guarded-by: _mtx
+        self._metrics = None  # OpsMetrics, bound by the node  # guarded-by: _mtx
 
     # --- wiring --------------------------------------------------------------
 

@@ -151,19 +151,19 @@ class PrecomputeCache:
         self._lock = threading.RLock()
         self._entries: "OrderedDict[bytes, Tuple[np.ndarray, bool]]" = (
             OrderedDict()
-        )
+        )  # guarded-by: _lock
         self._active_sets: "OrderedDict[bytes, FrozenSet[bytes]]" = (
             OrderedDict()
-        )
-        self._eligible: FrozenSet[bytes] = frozenset()
-        self._pinned: set = set()
-        self._metrics = None
-        self.hits = 0
-        self.misses = 0
-        self.builds = 0
-        self.evictions = 0
-        self.invalidations = 0
-        self.build_seconds = 0.0
+        )  # guarded-by: _lock
+        self._eligible: FrozenSet[bytes] = frozenset()  # guarded-by: _lock
+        self._pinned: set = set()  # guarded-by: _lock
+        self._metrics = None  # guarded-by: _lock
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.builds = 0  # guarded-by: _lock
+        self.evictions = 0  # guarded-by: _lock
+        self.invalidations = 0  # guarded-by: _lock
+        self.build_seconds = 0.0  # guarded-by: _lock
 
     # --- configuration ------------------------------------------------------
 
@@ -224,7 +224,7 @@ class PrecomputeCache:
                 if self._metrics is not None:
                     self._metrics.precompute_invalidations.inc(len(stale))
 
-    def _eligible_for_build(self, pk: bytes) -> bool:
+    def _eligible_for_build_locked(self, pk: bytes) -> bool:
         mode = _mode()
         if mode == "all":
             return True
@@ -287,7 +287,7 @@ class PrecomputeCache:
                         entry = entries[seen[pk]]
                         if entry is None:  # first occurrence was ineligible
                             continue
-                    elif self._eligible_for_build(pk):
+                    elif self._eligible_for_build_locked(pk):
                         misses += 1
                         t0 = time.perf_counter()
                         table, ok = build_table(pk)
@@ -366,10 +366,10 @@ class ResultCache:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[bytes, bool]" = OrderedDict()
-        self._metrics = None
-        self.hits = 0
-        self.misses = 0
+        self._entries: "OrderedDict[bytes, bool]" = OrderedDict()  # guarded-by: _lock
+        self._metrics = None  # guarded-by: _lock
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
 
     @property
     def cap(self) -> int:

@@ -142,7 +142,7 @@ class RPCServer:
                     try:
                         self.wfile.write(body)
                     except (BrokenPipeError, ConnectionResetError):
-                        pass
+                        pass  # scraper hung up mid-response; nothing to answer
                     return
                 params: Dict[str, Any] = {}
                 for k, v in parse_qsl(parsed.query):
@@ -177,7 +177,7 @@ class RPCServer:
                 try:
                     self.wfile.write(body)
                 except (BrokenPipeError, ConnectionResetError):
-                    pass
+                    pass  # client hung up mid-response; nothing to answer
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True

@@ -172,8 +172,8 @@ class VerifydClient:
                 self._pool.remove(ch)
                 try:
                     ch.close()
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # already-dead channel; discard is the point
             else:
                 self._free.append(ch)
             self._available.notify()
@@ -183,8 +183,8 @@ class VerifydClient:
             for ch in self._pool:
                 try:
                     ch.close()
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # best-effort teardown of a possibly-dead channel
             self._pool.clear()
             self._free.clear()
             self._available.notify_all()

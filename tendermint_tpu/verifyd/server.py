@@ -91,7 +91,7 @@ class AdmissionController:
     ):
         self.cap = cap
         self.service_budget = service_budget
-        self._lane_ewma = 0.0  # seconds per lane, learned
+        self._lane_ewma = 0.0  # seconds per lane, learned  # guarded-by: _mtx
         self._mtx = threading.Lock()
 
     def observe_flush(self, lanes: int, seconds: float) -> None:
@@ -153,17 +153,20 @@ class VerifydServer:
         self._sched_args = dict(
             max_batch=max_batch, max_delay=max_delay, max_pending=max_pending
         )
-        self._schedulers: Dict[int, VerifyScheduler] = {}
+        self._schedulers: Dict[int, VerifyScheduler] = {}  # guarded-by: _sched_mtx
         self._sched_mtx = threading.Lock()
         self._depth_mtx = threading.Lock()
-        self._class_depth: Dict[int, int] = {}
-        # plain counters for tests and bench (metrics-free introspection)
+        self._class_depth: Dict[int, int] = {}  # guarded-by: _depth_mtx
+        # plain counters for tests and bench (metrics-free introspection).
+        # Handler threads and both schedulers' accumulator threads all
+        # write these, so they take their own mutex.
+        self._stats_mtx = threading.Lock()
         self.cross_client_flushes: Dict[str, int] = {
             "size": 0, "deadline": 0, "shutdown": 0,
-        }
-        self.admission_rejections = 0
-        self.deadline_expired = 0
-        self.requests_served = 0
+        }  # guarded-by: _stats_mtx
+        self.admission_rejections = 0  # guarded-by: _stats_mtx
+        self.deadline_expired = 0  # guarded-by: _stats_mtx
+        self.requests_served = 0  # guarded-by: _stats_mtx
         self._grpc = GrpcServer({VERIFY_PATH: self._handle}, host, port)
 
     # --- lifecycle ----------------------------------------------------------
@@ -211,9 +214,10 @@ class VerifydServer:
         self.metrics.flushes.labels(reason=reason).inc()
         self.metrics.batch_occupancy.observe(lanes)
         if len({p.tag for p in batch}) > 1:
-            self.cross_client_flushes[reason] = (
-                self.cross_client_flushes.get(reason, 0) + 1
-            )
+            with self._stats_mtx:
+                self.cross_client_flushes[reason] = (
+                    self.cross_client_flushes.get(reason, 0) + 1
+                )
             self.metrics.cross_client_flushes.labels(reason=reason).inc()
 
     # --- per-class depth gauge ----------------------------------------------
@@ -238,7 +242,8 @@ class VerifydServer:
         queue_depth: int = 0,
     ) -> bytes:
         with tracing.span("verifyd_respond", status=STATUS_NAMES[status]):
-            self.requests_served += 1
+            with self._stats_mtx:
+                self.requests_served += 1
             self.metrics.requests.labels(
                 kind=kind_name, status=STATUS_NAMES[status]
             ).inc()
@@ -276,7 +281,8 @@ class VerifydServer:
             depth = sched.pending_depth()
             shed = self.admission.admit(req.klass, n, depth)
             if shed is not None:
-                self.admission_rejections += 1
+                with self._stats_mtx:
+                    self.admission_rejections += 1
                 self.metrics.admission_rejections.labels(
                     klass=klass_name, reason=shed
                 ).inc()
@@ -340,7 +346,8 @@ class VerifydServer:
                         if deadline_s:
                             left = deadline_s - (time.monotonic() - t0)
                             if left <= 0 or not entry.done.wait(timeout=left):
-                                self.deadline_expired += 1
+                                with self._stats_mtx:
+                                    self.deadline_expired += 1
                                 return self._respond(
                                     STATUS_DEADLINE_EXCEEDED,
                                     [],

@@ -16,11 +16,31 @@ os.environ["XLA_FLAGS"] = (
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# The lock-order sanitizer must patch threading BEFORE jax (and the
+# package under test) create any locks, so this sits above the jax
+# import. Activated only by TENDERMINT_TPU_SANITIZE=1 (ci_checks.sh).
+from tendermint_tpu.libs import sanitizer as _sanitizer
+
+if _sanitizer.enabled_from_env():
+    _sanitizer.install()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 
 import pytest
+
+
+def pytest_terminal_summary(terminalreporter):
+    """With the sanitizer on, print its findings at the end of the run.
+    ci_checks.sh greps the output for the LOCK-ORDER CYCLE marker."""
+    if _sanitizer.installed():
+        class _Writer:
+            def write(self, text):
+                terminalreporter.write(text)
+
+        terminalreporter.section("lock-order sanitizer")
+        _sanitizer.print_report(_Writer())
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,612 @@
+"""tpulint framework tests: every checker family must flag its target
+pattern (fixture) and stay quiet on the clean twin, and the repo itself
+must pass ``python -m scripts.analysis`` with the committed baseline."""
+
+import textwrap
+
+import pytest
+
+from scripts.analysis import checker_registry
+from scripts.analysis.core import (
+    Finding,
+    Module,
+    Project,
+    Runner,
+    diff_baseline,
+    load_baseline,
+    write_baseline,
+)
+from scripts.analysis.hygiene import HygieneChecker
+from scripts.analysis.jaxpurity import JaxPurityChecker
+from scripts.analysis.locks import LockDisciplineChecker
+from scripts.analysis.metrics_checks import MetricsChecker
+from scripts.analysis.wire import WireCompatChecker
+
+
+def run_on(checker, sources):
+    """sources: {rel_path: code}. Returns list of finding codes+lines."""
+    modules = [
+        Module(rel, textwrap.dedent(src), rel=rel)
+        for rel, src in sources.items()
+    ]
+    return Runner([checker]).run(modules)
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# --- lock discipline ---------------------------------------------------------
+
+
+LOCKED_DIRTY = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._mtx = threading.Lock()
+            self._items = []  # guarded-by: _mtx
+
+        def bad(self):
+            return len(self._items)
+
+        def good(self):
+            with self._mtx:
+                return len(self._items)
+"""
+
+LOCKED_CLEAN = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._mtx = threading.Lock()
+            self._items = []  # guarded-by: _mtx
+
+        def good(self):
+            with self._mtx:
+                return len(self._items)
+"""
+
+
+class TestLockDiscipline:
+    def test_flags_unlocked_access(self):
+        found = run_on(LockDisciplineChecker(), {"m.py": LOCKED_DIRTY})
+        assert codes(found) == ["TPL001"]
+        assert "_items" in found[0].message
+
+    def test_clean_twin_passes(self):
+        assert run_on(LockDisciplineChecker(), {"m.py": LOCKED_CLEAN}) == []
+
+    def test_condition_wraps_lock_alias(self):
+        src = """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._mtx = threading.Lock()
+                    self._wake = threading.Condition(self._mtx)
+                    self._pending = []  # guarded-by: _mtx
+
+                def drain(self):
+                    with self._wake:
+                        return list(self._pending)
+        """
+        assert run_on(LockDisciplineChecker(), {"m.py": src}) == []
+
+    def test_none_annotation_documents_lock_free(self):
+        src = """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._mtx = threading.Lock()
+                    self.hits = 0  # guarded-by: none(single-writer stats)
+
+                def bump(self):
+                    self.hits += 1
+        """
+        assert run_on(LockDisciplineChecker(), {"m.py": src}) == []
+
+    def test_unknown_lock_name(self):
+        src = """
+            class S:
+                def __init__(self):
+                    self.x = 0  # guarded-by: _nope
+        """
+        found = run_on(LockDisciplineChecker(), {"m.py": src})
+        assert codes(found) == ["TPL002"]
+
+    def test_orphan_annotation(self):
+        src = """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._mtx = threading.Lock()
+                    # guarded-by: _mtx
+                    pass
+        """
+        found = run_on(LockDisciplineChecker(), {"m.py": src})
+        assert codes(found) == ["TPL003"]
+
+    def test_locked_suffix_methods_assume_lock_held(self):
+        src = """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._mtx = threading.Lock()
+                    self._v = 0  # guarded-by: _mtx
+
+                def _bump_locked(self):
+                    self._v += 1
+        """
+        assert run_on(LockDisciplineChecker(), {"m.py": src}) == []
+
+    def test_nested_def_resets_held_locks(self):
+        src = """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._mtx = threading.Lock()
+                    self._v = 0  # guarded-by: _mtx
+
+                def spawn(self):
+                    with self._mtx:
+                        def cb():
+                            return self._v  # escapes the critical section
+                        return cb
+        """
+        found = run_on(LockDisciplineChecker(), {"m.py": src})
+        assert codes(found) == ["TPL001"]
+
+    def test_base_class_lock_is_inherited(self):
+        src = """
+            import threading
+
+            class Base:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+            class Child(Base):
+                def __init__(self):
+                    super().__init__()
+                    self._v = {}  # guarded-by: _lock
+
+                def get(self):
+                    with self._lock:
+                        return dict(self._v)
+        """
+        assert run_on(LockDisciplineChecker(), {"m.py": src}) == []
+
+
+# --- JAX purity --------------------------------------------------------------
+
+
+JIT_DIRTY = """
+    import time
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(x):
+        t = time.monotonic()
+        return x + t
+"""
+
+JIT_CLEAN = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(x):
+        return x * 2
+"""
+
+
+class TestJaxPurity:
+    def run(self, src):
+        return run_on(
+            JaxPurityChecker(), {"tendermint_tpu/ops/fix.py": src}
+        )
+
+    def test_flags_time_call_in_jitted_fn(self):
+        found = self.run(JIT_DIRTY)
+        assert codes(found) == ["TPJ001"]
+        assert "time.monotonic" in found[0].message
+
+    def test_clean_twin_passes(self):
+        assert self.run(JIT_CLEAN) == []
+
+    def test_reachability_through_helper(self):
+        src = """
+            import time
+            import jax
+
+            def helper(x):
+                print(x)
+                return x
+
+            @jax.jit
+            def kernel(x):
+                return helper(x)
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPJ001"]
+        assert "print" in found[0].message
+
+    def test_unreachable_helper_may_do_io(self):
+        src = """
+            import jax
+
+            def host_only(path):
+                return open(path).read()
+
+            @jax.jit
+            def kernel(x):
+                return x
+        """
+        assert self.run(src) == []
+
+    def test_branch_on_traced_value(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                if x > 0:
+                    return x
+                return -x
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPJ002"]
+
+    def test_shape_branch_is_static(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                if x.shape[0] > 8:
+                    return x[:8]
+                return x
+        """
+        assert self.run(src) == []
+
+    def test_string_compare_is_host_config(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def kernel(x, mode="a"):
+                if mode == "a":
+                    return x
+                return -x
+        """
+        assert self.run(src) == []
+
+    def test_dtype_discipline(self):
+        src = """
+            import jax.numpy as jnp
+
+            def table():
+                return jnp.zeros((4,), dtype=jnp.float64)
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPJ003"]
+
+    def test_jit_call_entry_point(self):
+        src = """
+            import jax
+
+            def run(x):
+                print(x)
+                return x
+
+            compiled = jax.jit(run)
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPJ001"]
+
+
+# --- wire compat -------------------------------------------------------------
+
+
+WIRE_DIRTY = """
+    CLASS_CONSENSUS = 0
+    CLASS_RPC = 3
+    CLASS_NAMES = {CLASS_CONSENSUS: "consensus", CLASS_RPC: "rpc"}
+
+    def _put_varint(out, v):
+        out.append(v)
+
+    def encode(req):
+        out = []
+        if req.klass:
+            _put_varint(out, req.klass)
+        return out
+
+    def decode(data):
+        klass = CLASS_RPC
+        return klass
+"""
+
+WIRE_CLEAN = """
+    CLASS_CONSENSUS = 0
+    CLASS_RPC = 3
+    CLASS_NAMES = {CLASS_CONSENSUS: "consensus", CLASS_RPC: "rpc"}
+
+    def _put_varint(out, v):
+        out.append(v)
+
+    def encode(req):
+        out = []
+        _put_varint(out, req.klass + 1)
+        return out
+
+    def decode(r, req):
+        req.klass = r.read_varint() - 1
+        return req
+"""
+
+
+class TestWireCompat:
+    def run(self, src):
+        return run_on(
+            WireCompatChecker(), {"tendermint_tpu/verifyd/protocol.py": src}
+        )
+
+    def test_flags_zero_omitted_meaningful_enum(self):
+        found = self.run(WIRE_DIRTY)
+        assert codes(found) == ["TPW001"]
+        assert "CLASS_CONSENSUS" in found[0].message
+
+    def test_shifted_twin_passes(self):
+        assert self.run(WIRE_CLEAN) == []
+
+    def test_asymmetric_shift(self):
+        src = """
+            CLASS_CONSENSUS = 0
+            CLASS_NAMES = {CLASS_CONSENSUS: "consensus"}
+
+            def encode(req, out):
+                out.append(req.klass + 1)
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPW002"]
+        assert "never decoded" in found[0].message
+
+    def test_conditional_grpc_status(self):
+        src = """
+            def trailers(conn, status):
+                hdrs = []
+                if status:
+                    hdrs.append(("grpc-status", str(status)))
+                conn.send(hdrs)
+        """
+        found = run_on(
+            WireCompatChecker(), {"tendermint_tpu/libs/grpc.py": src}
+        )
+        assert codes(found) == ["TPW003"]
+
+    def test_unconditional_grpc_status_passes(self):
+        src = """
+            def trailers(conn, status):
+                conn.send([("grpc-status", str(status))])
+        """
+        assert (
+            run_on(WireCompatChecker(), {"tendermint_tpu/libs/grpc.py": src})
+            == []
+        )
+
+    def test_non_wire_files_ignored(self):
+        assert run_on(WireCompatChecker(), {"other.py": WIRE_DIRTY}) == []
+
+
+# --- hygiene -----------------------------------------------------------------
+
+
+class TestHygiene:
+    def test_bare_except(self):
+        src = """
+            try:
+                x = 1
+            except:
+                x = 2
+        """
+        found = run_on(HygieneChecker(), {"m.py": src})
+        assert codes(found) == ["TPH001"]
+
+    def test_silent_pass_without_comment(self):
+        src = """
+            try:
+                x = 1
+            except ValueError:
+                pass
+        """
+        found = run_on(HygieneChecker(), {"m.py": src})
+        assert codes(found) == ["TPH002"]
+
+    def test_commented_pass_is_fine(self):
+        src = """
+            try:
+                x = 1
+            except ValueError:
+                pass  # best-effort: unparsable input keeps the default
+        """
+        assert run_on(HygieneChecker(), {"m.py": src}) == []
+
+    def test_non_daemon_unjoined_thread(self):
+        src = """
+            import threading
+            t = threading.Thread(target=print)
+            t.start()
+        """
+        found = run_on(HygieneChecker(), {"m.py": src})
+        assert codes(found) == ["TPH003"]
+
+    def test_daemon_thread_is_fine(self):
+        src = """
+            import threading
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+        """
+        assert run_on(HygieneChecker(), {"m.py": src}) == []
+
+    def test_joined_thread_is_fine(self):
+        src = """
+            import threading
+            t = threading.Thread(target=print)
+            t.start()
+            t.join()
+        """
+        assert run_on(HygieneChecker(), {"m.py": src}) == []
+
+    def test_fstring_into_logger(self):
+        src = """
+            def f(logger, n):
+                logger.info(f"flushed {n} lanes")
+        """
+        found = run_on(HygieneChecker(), {"m.py": src})
+        assert codes(found) == ["TPH004"]
+
+    def test_kv_logging_is_fine(self):
+        src = """
+            def f(logger, n):
+                logger.info("flushed", lanes=n)
+        """
+        assert run_on(HygieneChecker(), {"m.py": src}) == []
+
+
+# --- metrics -----------------------------------------------------------------
+
+
+METRICS_REL = "tendermint_tpu/libs/metrics.py"
+
+
+class TestMetricsChecks:
+    def test_dead_instrument(self):
+        metrics_src = """
+            NAMESPACE = "tendermint"
+
+            def _name(s, n):
+                return f"tendermint_{s}_{n}"
+
+            class M:
+                def __init__(self, reg):
+                    s = "demo"
+                    self.used = reg.counter(_name(s, "used_total"), "h")
+                    self.dead = reg.counter(_name(s, "dead_total"), "h")
+        """
+        user_src = """
+            def f(m):
+                m.used.inc()
+        """
+        found = run_on(
+            MetricsChecker(),
+            {METRICS_REL: metrics_src, "tendermint_tpu/ops/u.py": user_src},
+        )
+        assert codes(found) == ["TPM001"]
+        assert "dead" in found[0].message
+
+    def test_bad_name(self):
+        metrics_src = """
+            class M:
+                def __init__(self, reg):
+                    self.x = reg.counter("Bad-Name", "h")
+        """
+        user_src = """
+            def f(m):
+                m.x.inc()
+        """
+        found = run_on(
+            MetricsChecker(),
+            {METRICS_REL: metrics_src, "tendermint_tpu/ops/u.py": user_src},
+        )
+        assert codes(found) == ["TPM002"]
+
+
+# --- framework mechanics -----------------------------------------------------
+
+
+class TestFramework:
+    def test_inline_suppression(self):
+        src = """
+            try:
+                x = 1
+            except:  # tpulint: disable=TPH001
+                x = 2
+        """
+        assert run_on(HygieneChecker(), {"m.py": src}) == []
+
+    def test_render_shape(self):
+        f = Finding("a/b.py", 12, "TPX001", "boom")
+        assert f.render() == "a/b.py:12: TPX001 boom"
+        assert f.baseline_key() == "a/b.py: TPX001 boom"
+
+    def test_baseline_roundtrip(self, tmp_path):
+        path = str(tmp_path / "baseline.txt")
+        f1 = Finding("a.py", 1, "TPH002", "x")
+        f2 = Finding("a.py", 9, "TPH002", "x")  # same key, twice
+        write_baseline(path, [f1, f2])
+        baseline = load_baseline(path)
+        new, stale = diff_baseline([f1, f2], baseline)
+        assert new == [] and stale == []
+        # a third identical finding is NEW (multiset semantics)
+        f3 = Finding("a.py", 20, "TPH002", "x")
+        new, stale = diff_baseline([f1, f2, f3], baseline)
+        assert len(new) == 1 and stale == []
+        # fixing one leaves a stale entry to prune
+        new, stale = diff_baseline([f1], baseline)
+        assert new == [] and stale == ["a.py: TPH002 x"]
+
+    def test_line_drift_does_not_unbaseline(self, tmp_path):
+        path = str(tmp_path / "baseline.txt")
+        write_baseline(path, [Finding("a.py", 10, "TPH002", "x")])
+        moved = Finding("a.py", 999, "TPH002", "x")
+        new, stale = diff_baseline([moved], load_baseline(path))
+        assert new == [] and stale == []
+
+    def test_registry_covers_all_families(self):
+        reg = checker_registry()
+        assert set(reg) == {"locks", "jaxpurity", "wire", "hygiene", "metrics"}
+
+    def test_comment_in_string_is_not_an_annotation(self):
+        src = '''
+            class S:
+                def __init__(self):
+                    self.x = "text with # guarded-by: _mtx inside"
+        '''
+        assert run_on(LockDisciplineChecker(), {"m.py": src}) == []
+
+
+# --- the repo itself ---------------------------------------------------------
+
+
+class TestRepoPasses:
+    def test_repo_passes_with_baseline(self, capsys):
+        from scripts.analysis.__main__ import main
+
+        rc = main([])
+        out = capsys.readouterr().out
+        assert rc == 0, f"tpulint found new findings:\n{out}"
+
+    def test_annotated_files_have_guards(self):
+        # the ISSUE's seed files must actually carry annotations
+        import os
+
+        from scripts.analysis.core import REPO_ROOT
+
+        seeded = [
+            "tendermint_tpu/crypto/scheduler.py",
+            "tendermint_tpu/verifyd/server.py",
+            "tendermint_tpu/ops/device_policy.py",
+            "tendermint_tpu/ops/precompute.py",
+            "tendermint_tpu/libs/tracing.py",
+            "tendermint_tpu/libs/metrics.py",
+        ]
+        for rel in seeded:
+            with open(os.path.join(REPO_ROOT, rel)) as fh:
+                assert "guarded-by:" in fh.read(), f"{rel} lost its annotations"
