@@ -3,662 +3,33 @@
 
 Mirrors the reference's BenchmarkVerifyBatch (crypto/ed25519/bench_test.go:31-67)
 at large batch — the hot path of VerifyCommit / blocksync / light client
-(types/validation.go:154) — plus a VerifyCommit p50 latency at 10k
-validators (BASELINE.md tracked metric). Prints ONE JSON line:
+(types/validation.go:154) — plus VerifyCommit latency, light-client /
+blocksync / cache / verifyd / multichip sections. Prints ONE JSON line:
 
-    {"metric": ..., "value": N, "unit": "sigs/s", "vs_baseline": N, ...}
+    {"metric": ..., "value": N, "unit": "sigs/s", "vs_baseline": N,
+     ..., "sections": {...per-section status...}}
 
 vs_baseline divides by the reference's Go batch-verify throughput class
 (curve25519-voi batched verify ~33 us/sig on a modern x86 core =>
-30,000 sigs/s; no Go toolchain exists in this image to measure it
-directly — see BASELINE.md).
+30,000 sigs/s; no Go toolchain exists in this image — see BASELINE.md).
 
-Robustness contract (a flaky accelerator backend must degrade the
-report, not zero it): the measurement runs in a child process under a
-hard wall-clock timeout; if the child dies or hangs on the configured
-backend, the parent retries it on CPU and reports backend="cpu" with
-the failure recorded under "probe". Every attempt is appended to
-scripts/TPU_PROBE_LOG.md.
+Robustness contract (ISSUE 6): a flaky accelerator relay must degrade
+the report, never zero it. Every section runs in its OWN subprocess
+under a heartbeat watchdog; each completed section is persisted to a
+partial-result JSON before the next one starts; failed sections retry
+down a size-degradation ladder and land with an honest status
+(ok|timeout|crashed|skipped) instead of killing the round. See
+bench/runner.py for the orchestration and README "Benchmarking" for
+the knobs, the partial-result format, and ``--resume``.
 """
 
-import json
 import os
-import subprocess
 import sys
-import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
-GO_CPU_BATCH_SIGS_PER_SEC = 30_000.0  # curve25519-voi batch verify, 1 core
-
-BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
-ROUNDS = int(os.environ.get("BENCH_ROUNDS", "5"))
-COMMIT_VALS = int(os.environ.get("BENCH_COMMIT_VALS", "10000"))
-CHILD_TIMEOUT = float(os.environ.get("BENCH_TIMEOUT", "1500"))
-# Cheap backend liveness probe (import jax + one tiny jit) before the
-# full child, so a dead accelerator costs this instead of BENCH_TIMEOUT.
-PROBE_TIMEOUT = float(os.environ.get("TENDERMINT_TPU_PROBE_TIMEOUT", "120"))
-CACHE_VALS = int(os.environ.get("BENCH_CACHE_VALS", "100"))
-# BASELINE configs 3 & 4 (light-client chain walk, pipelined blocksync)
-LIGHT_HEADERS = int(os.environ.get("BENCH_LIGHT_HEADERS", "16"))
-LIGHT_VALS = int(os.environ.get("BENCH_LIGHT_VALS", "1000"))
-SYNC_BLOCKS = int(os.environ.get("BENCH_SYNC_BLOCKS", "32"))
-SYNC_VALS = int(os.environ.get("BENCH_SYNC_VALS", "500"))
-# verifyd wire-vs-inproc comparison (in-process daemon, localhost wire)
-VERIFYD_CLIENTS = int(os.environ.get("BENCH_VERIFYD_CLIENTS", "4"))
-VERIFYD_LANES = int(os.environ.get("BENCH_VERIFYD_LANES", "64"))
-VERIFYD_ROUNDS = int(os.environ.get("BENCH_VERIFYD_ROUNDS", "8"))
-
-
-def _log_probe(line: str) -> None:
-    try:
-        with open(os.path.join(REPO, "scripts", "TPU_PROBE_LOG.md"), "a") as f:
-            f.write(
-                "- %s — %s\n"
-                % (time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), line)
-            )
-    except OSError:
-        pass
-
-
-# --------------------------------------------------------------------------
-# Child: the actual measurement. Runs with whatever JAX_PLATFORMS the
-# parent passed; prints one JSON object on success.
-# --------------------------------------------------------------------------
-
-
-def _make_workload(rng, batch):
-    from tendermint_tpu.crypto.keys import Ed25519PrivKey
-
-    n_keys = 256  # distinct signers, cycled (commit-like workload)
-    privs = [
-        Ed25519PrivKey.from_seed(bytes(rng.integers(0, 256, 32, dtype="uint8")))
-        for _ in range(n_keys)
-    ]
-    pubs = [p.pub_key().bytes() for p in privs]
-    msgs = [bytes(rng.integers(0, 256, 120, dtype="uint8")) for _ in range(batch)]
-    pks = [pubs[i % n_keys] for i in range(batch)]
-    sigs = [privs[i % n_keys].sign(msgs[i]) for i in range(batch)]
-    return pks, msgs, sigs
-
-
-def _stage_breakdown(pks, msgs, sigs):
-    """One instrumented pass: prep / H2D / kernel / D2H wall times (s)."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from tendermint_tpu.ops import ed25519_batch
-
-    t0 = time.perf_counter()
-    inputs, host_ok = ed25519_batch.prepare_batch(
-        pks, msgs, sigs, pad_to=ed25519_batch._bucket(len(pks))
-    )
-    t_prep = time.perf_counter() - t0
-
-    m = inputs["pk"].shape[0]
-    chunk = ed25519_batch.CHUNK
-    impl = ed25519_batch.active_impl()
-
-    t0 = time.perf_counter()
-    dev = []
-    for lo in range(0, m, chunk):
-        hi = min(lo + chunk, m)
-        dev.append(
-            tuple(
-                jax.device_put(jnp.asarray(inputs[k][lo:hi]))
-                for k in ("pk", "r", "s", "k")
-            )
-        )
-    for args in dev:
-        for a in args:
-            a.block_until_ready()
-    t_h2d = time.perf_counter() - t0
-
-    fns = []
-    for args in dev:
-        n_chunk = args[0].shape[0]
-        if impl == "pallas":
-            from tendermint_tpu.ops import pallas_verify
-
-            fns.append(pallas_verify.compiled_verify(n_chunk))
-        else:
-            from tendermint_tpu.ops import field32
-
-            mul_impl = "mxu" if impl == "mxu" else field32.get_mul_impl()
-            fns.append(ed25519_batch._compiled_kernel(n_chunk, None, mul_impl))
-    outs = [fn(*args) for fn, args in zip(fns, dev)]  # warmup/compile
-    for o in outs:
-        o.block_until_ready()
-
-    t0 = time.perf_counter()
-    outs = [fn(*args) for fn, args in zip(fns, dev)]
-    for o in outs:
-        o.block_until_ready()
-    t_kernel = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    _ = np.concatenate([np.asarray(o) for o in outs])
-    t_d2h = time.perf_counter() - t0
-
-    return {
-        "prep_ms": round(t_prep * 1e3, 2),
-        "h2d_ms": round(t_h2d * 1e3, 2),
-        "kernel_ms": round(t_kernel * 1e3, 2),
-        "d2h_ms": round(t_d2h * 1e3, 2),
-        "impl": impl,
-    }
-
-
-def _load_helpers():
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "bench_helpers", os.path.join(REPO, "tests", "helpers.py")
-    )
-    helpers = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(helpers)
-    return helpers
-
-
-def _build_header_chain(n_heights, n_vals):
-    """Signed-header chain with a constant validator set (the shape of
-    light/client_benchmark_test.go's fixture)."""
-    import hashlib
-
-    from tendermint_tpu.encoding.canonical import Timestamp
-    from tendermint_tpu.types import (
-        BlockID,
-        Consensus,
-        Header,
-        PartSetHeader,
-        SignedHeader,
-    )
-
-    helpers = _load_helpers()
-    base_ns = 1_700_000_000_000_000_000
-    privs, vset = helpers.make_validators(n_vals)
-    chain = []
-    last_bid = BlockID()
-    for h in range(1, n_heights + 1):
-        header = Header(
-            version=Consensus(block=11),
-            chain_id=helpers.CHAIN_ID,
-            height=h,
-            time=Timestamp.from_unix_ns(base_ns + h * 1_000_000_000),
-            last_block_id=last_bid,
-            last_commit_hash=hashlib.sha256(b"lc%d" % h).digest(),
-            data_hash=hashlib.sha256(b"d%d" % h).digest(),
-            validators_hash=vset.hash(),
-            next_validators_hash=vset.hash(),
-            consensus_hash=hashlib.sha256(b"cp").digest(),
-            app_hash=hashlib.sha256(b"app%d" % h).digest(),
-            last_results_hash=b"",
-            evidence_hash=b"",
-            proposer_address=vset.validators[0].address,
-        )
-        bid = BlockID(
-            header.hash(), PartSetHeader(1, hashlib.sha256(b"p%d" % h).digest())
-        )
-        commit = helpers.make_commit(
-            bid, h, 0, vset, privs, time_ns=base_ns + h * 1_000_000_000
-        )
-        chain.append(SignedHeader(header=header, commit=commit))
-        last_bid = bid
-    return chain, vset, helpers.CHAIN_ID
-
-
-def _light_client_headers_per_s(n_headers, n_vals):
-    """BASELINE config 3: light-client sequential chain walk at n_vals
-    validators — each step is a VerifyAdjacent (valhash link + 2/3
-    commit verify on the device batch path). Match:
-    light/client_benchmark_test.go, light/verifier.go:106-152."""
-    from tendermint_tpu.encoding.canonical import Timestamp
-    from tendermint_tpu.light.verifier import verify_adjacent
-
-    chain, vset, _ = _build_header_chain(n_headers, n_vals)
-    now = Timestamp.from_unix_ns(
-        1_700_000_000_000_000_000 + (n_headers + 2) * 1_000_000_000
-    )
-
-    def walk():
-        for i in range(1, len(chain)):
-            verify_adjacent(chain[i - 1], chain[i], vset, 86400.0, now, 10.0)
-
-    walk()  # warmup/compile
-    t0 = time.perf_counter()
-    walk()
-    dt = time.perf_counter() - t0
-    return round((len(chain) - 1) / dt, 2)
-
-
-def _blocksync_blocks_per_s(n_blocks, n_vals):
-    """BASELINE config 4: a blocksync catch-up window's commits flattened
-    into one pipelined device batch. Match:
-    internal/blocksync/reactor.go:538-650 (serial VerifyCommitLight in
-    the reference), parallel/pipeline.py here."""
-    from tendermint_tpu.parallel.pipeline import CommitTask, verify_commits_pipelined
-
-    chain, vset, chain_id = _build_header_chain(n_blocks, n_vals)
-    tasks = [
-        CommitTask(chain_id, vset, sh.commit.block_id, sh.header.height, sh.commit)
-        for sh in chain
-    ]
-    verdicts = verify_commits_pipelined(tasks)  # warmup/compile
-    assert all(v.ok for v in verdicts), "benchmark commits must verify"
-    t0 = time.perf_counter()
-    verdicts = verify_commits_pipelined(tasks)
-    dt = time.perf_counter() - t0
-    assert all(v.ok for v in verdicts)
-    return round(n_blocks / dt, 2)
-
-
-def _mixed_key_factory(i: int):
-    """Alternating ed25519 / sr25519 keys (BASELINE config 5 mix);
-    verification sub-batches per key type (crypto/batch
-    MultiBatchVerifier -> ops/ed25519_batch + ops/sr25519_batch)."""
-    from tendermint_tpu.crypto.keys import Ed25519PrivKey
-    from tendermint_tpu.crypto.sr25519 import Sr25519PrivKey
-
-    if i % 2 == 0:
-        return Ed25519PrivKey.from_seed(i.to_bytes(32, "big"))
-    return Sr25519PrivKey.from_secret(b"bench-sr" + i.to_bytes(4, "big"))
-
-
-def _verify_commit_p50(n_vals: int, iters: int = 7):
-    """p50 end-to-end VerifyCommit latency at n_vals validators
-    (types/validation.go:27-54 semantics; BASELINE.md tracked metric).
-    BENCH_COMMIT_MIX=mixed makes the set half ed25519 / half sr25519."""
-    helpers = _load_helpers()
-
-    from tendermint_tpu.types import validation
-
-    if os.environ.get("BENCH_COMMIT_MIX", "ed") == "mixed":
-        privs, vset = helpers.make_validators(
-            n_vals, key_factory=_mixed_key_factory
-        )
-    else:
-        privs, vset = helpers.make_validators(n_vals)
-    block_id = helpers.make_block_id()
-    commit = helpers.make_commit(block_id, 5, 0, vset, privs)
-    # warmup (compiles the padded bucket)
-    validation.verify_commit(helpers.CHAIN_ID, vset, block_id, 5, commit)
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        validation.verify_commit(helpers.CHAIN_ID, vset, block_id, 5, commit)
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    return round(times[len(times) // 2] * 1e3, 2)
-
-
-def _cache_amortization():
-    """Second-commit amortization at CACHE_VALS validators: the same
-    commit verified twice. Pass 1 pays the host-side precompute table
-    builds; pass 2 gathers every table from the validator-set cache
-    (zero builds). A third/fourth pass with the digest-keyed result
-    cache enabled shows the duplicate-commit short-circuit. Reported as
-    the "cache" section of the JSON line; the throughput loop above
-    runs with the result cache disabled so rounds stay comparable."""
-    from tendermint_tpu.ops import precompute
-    from tendermint_tpu.types import validation
-
-    helpers = _load_helpers()
-    privs, vset = helpers.make_validators(CACHE_VALS)
-    block_id = helpers.make_block_id()
-    commit = helpers.make_commit(block_id, 7, 0, vset, privs)
-    precompute.reset()
-
-    def one_pass():
-        t0 = time.perf_counter()
-        validation.verify_commit(helpers.CHAIN_ID, vset, block_id, 7, commit)
-        return time.perf_counter() - t0
-
-    cold = one_pass()  # compiles + builds tables
-    s1 = dict(precompute.stats()["precompute"])
-    warm = one_pass()  # tables gathered from the cache
-    s2 = dict(precompute.stats()["precompute"])
-    prev = os.environ.get("TENDERMINT_TPU_RESULT_CACHE")
-    os.environ["TENDERMINT_TPU_RESULT_CACHE"] = "1"
-    try:
-        one_pass()  # populates the result cache
-        cached = one_pass()  # answered from it
-    finally:
-        if prev is None:
-            os.environ.pop("TENDERMINT_TPU_RESULT_CACHE", None)
-        else:
-            os.environ["TENDERMINT_TPU_RESULT_CACHE"] = prev
-    rc = precompute.stats()["result_cache"]
-    warm_lookups = s2["hits"] + s2["misses"] - s1["hits"] - s1["misses"]
-    warm_hits = s2["hits"] - s1["hits"]
-    return {
-        "vals": CACHE_VALS,
-        "cold_ms": round(cold * 1e3, 2),
-        "warm_ms": round(warm * 1e3, 2),
-        "result_cached_ms": round(cached * 1e3, 2),
-        "builds_cold": s1["builds"],
-        "builds_warm": s2["builds"] - s1["builds"],
-        "table_hit_rate_warm": round(warm_hits / warm_lookups, 4)
-        if warm_lookups
-        else None,
-        "table_build_ms_total": round(s2["build_seconds"] * 1e3, 2),
-        "result_cache_hits": rc["hits"],
-        "result_cache_misses": rc["misses"],
-    }
-
-
-def _verifyd_wire_stats():
-    """Verification-as-a-service cost: an in-process verifyd daemon
-    serves VERIFYD_CLIENTS concurrent clients over the localhost wire,
-    each streaming VERIFYD_LANES-lane batches for VERIFYD_ROUNDS
-    rounds; the identical batch runs through the tiered dispatch
-    directly for the wire-overhead comparison. Batch occupancy and
-    cross-client flush counts come from the daemon's shared scheduler,
-    so they report the coalescing actually achieved, not the configured
-    ceiling."""
-    import threading
-
-    import numpy as np
-
-    from tendermint_tpu.crypto import batch as crypto_batch
-    from tendermint_tpu.verifyd import protocol
-    from tendermint_tpu.verifyd.client import VerifydClient
-    from tendermint_tpu.verifyd.server import VerifydServer
-
-    rng = np.random.default_rng(99)
-    pks, msgs, sigs = _make_workload(rng, VERIFYD_LANES)
-
-    # direct in-process dispatch of the same batch (warmed)
-    crypto_batch.tiered_verify_ed25519(pks, msgs, sigs)
-    t0 = time.perf_counter()
-    for _ in range(VERIFYD_ROUNDS):
-        crypto_batch.tiered_verify_ed25519(pks, msgs, sigs)
-    inproc_s = (time.perf_counter() - t0) / VERIFYD_ROUNDS
-
-    srv = VerifydServer(
-        max_batch=VERIFYD_LANES * VERIFYD_CLIENTS, max_delay=0.002
-    )
-    srv.start()
-    host, port = srv.address
-    lat = []
-    lat_mtx = threading.Lock()
-    errors = []
-
-    def run_client(i):
-        try:
-            c = VerifydClient(f"{host}:{port}", fallback=False)
-            for _ in range(VERIFYD_ROUNDS):
-                t = time.perf_counter()
-                oks = c.verify(
-                    pks, msgs, sigs, klass=protocol.CLASS_CONSENSUS
-                )
-                dt = time.perf_counter() - t
-                if not all(oks):
-                    raise AssertionError("verifyd rejected valid lanes")
-                with lat_mtx:
-                    lat.append(dt)
-            c.close()
-        except Exception as exc:
-            errors.append(repr(exc))
-
-    try:
-        warm = VerifydClient(f"{host}:{port}")
-        warm.verify(pks, msgs, sigs)
-        warm.close()
-        threads = [
-            threading.Thread(target=run_client, args=(i,))
-            for i in range(VERIFYD_CLIENTS)
-        ]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-        if errors or not lat:
-            return {"error": errors[:3] or ["no samples"]}
-        sched = srv.scheduler
-        lat.sort()
-        total_lanes = len(lat) * VERIFYD_LANES
-        return {
-            "clients": VERIFYD_CLIENTS,
-            "lanes_per_call": VERIFYD_LANES,
-            "wire_sigs_per_s": round(total_lanes / wall, 1),
-            "wire_p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
-            "wire_p95_ms": round(lat[int(len(lat) * 0.95)] * 1e3, 2),
-            "inproc_batch_ms": round(inproc_s * 1e3, 2),
-            "wire_overhead_x": round(
-                (sum(lat) / len(lat)) / inproc_s, 2
-            )
-            if inproc_s > 0
-            else None,
-            "flushes": sched.flushes,
-            "mean_batch_occupancy": round(
-                sched.entries_verified / max(1, sched.flushes), 1
-            ),
-            "cross_client_flushes": dict(srv.cross_client_flushes),
-        }
-    finally:
-        srv.stop()
-
-
-def child_main() -> None:
-    import numpy as np
-    import jax
-
-    # The axon site hook forces its platform regardless of JAX_PLATFORMS;
-    # only the config knob (applied before first backend use) overrides it.
-    if os.environ.get("BENCH_FORCE_CPU") == "1":
-        jax.config.update("jax_platforms", "cpu")
-
-    # Throughput rounds must measure verification, not dictionary hits:
-    # the digest-keyed result cache would answer rounds 2..N instantly.
-    # Explicit operator env still wins; _cache_amortization re-enables
-    # it locally to report the cache numbers.
-    os.environ.setdefault("TENDERMINT_TPU_RESULT_CACHE", "0")
-    # Span tracing in ring mode: trace_summary below comes from the spans
-    # the verify pipeline actually emitted. Explicit operator env wins.
-    os.environ.setdefault("TENDERMINT_TPU_TRACE", "ring")
-
-    from tendermint_tpu.libs import tracing
-    from tendermint_tpu.ops import ed25519_batch
-
-    tracing.configure()
-
-    backend = jax.default_backend()
-    rng = np.random.default_rng(1234)
-    pks, msgs, sigs = _make_workload(rng, BATCH)
-
-    # Warmup: compile + first run.
-    oks = ed25519_batch.verify_batch(pks, msgs, sigs)
-    assert all(oks), "benchmark signatures must verify"
-
-    best = 0.0
-    tracing.tracer.clear()  # summarize the measured rounds, not warmup
-    for _ in range(ROUNDS):
-        t0 = time.perf_counter()
-        ed25519_batch.verify_batch(pks, msgs, sigs)
-        dt = time.perf_counter() - t0
-        best = max(best, BATCH / dt)
-    trace_summary = tracing.tracer.summary() or None
-
-    stages = _stage_breakdown(pks, msgs, sigs)
-    commit_p50 = None
-    light_hps = sync_bps = cache_stats = None
-    if os.environ.get("BENCH_SKIP_COMMIT") != "1":
-        commit_p50 = _verify_commit_p50(COMMIT_VALS)
-    if os.environ.get("BENCH_SKIP_EXTRAS") != "1":
-        light_hps = _light_client_headers_per_s(LIGHT_HEADERS, LIGHT_VALS)
-        sync_bps = _blocksync_blocks_per_s(SYNC_BLOCKS, SYNC_VALS)
-    if os.environ.get("BENCH_SKIP_CACHE") != "1":
-        cache_stats = _cache_amortization()
-    verifyd_stats = None
-    if os.environ.get("BENCH_SKIP_VERIFYD") != "1":
-        verifyd_stats = _verifyd_wire_stats()
-
-    print(
-        json.dumps(
-            {
-                "metric": f"ed25519_batch_verify_throughput_b{BATCH}",
-                "value": round(best, 1),
-                "unit": "sigs/s",
-                "vs_baseline": round(best / GO_CPU_BATCH_SIGS_PER_SEC, 3),
-                "backend": backend,
-                "impl": stages.pop("impl"),
-                "stages_ms": stages,
-                "trace_summary": trace_summary,
-                f"verify_commit_p50_ms_v{COMMIT_VALS}": commit_p50,
-                f"light_client_headers_per_s_v{LIGHT_VALS}": light_hps,
-                f"blocksync_blocks_per_s_v{SYNC_VALS}": sync_bps,
-                "cache": cache_stats,
-                "verifyd": verifyd_stats,
-            }
-        ),
-        flush=True,
-    )
-
-
-# --------------------------------------------------------------------------
-# Parent: run the child under a hard timeout; degrade to CPU on failure.
-# --------------------------------------------------------------------------
-
-
-def _run_child(env_overrides, timeout):
-    env = dict(os.environ)
-    env.update(env_overrides)
-    if env.get("BENCH_FORCE_CPU") == "1":
-        # The CPU fallback must be immune to accelerator infrastructure
-        # (the axon site hook can block `import jax` when the TPU relay
-        # is down); one shared policy with the dryrun child.
-        import __graft_entry__
-
-        hook_free = __graft_entry__.hook_free_cpu_env()
-        env["PYTHONPATH"] = hook_free["PYTHONPATH"]
-        env["JAX_PLATFORMS"] = hook_free["JAX_PLATFORMS"]
-        # Degraded-evidence sizes: full-size configs take ~9 min on a
-        # loaded CPU (measured); the fallback's job is to land a number,
-        # not the headline. Explicit operator env still wins.
-        for k, v in (
-            ("BENCH_BATCH", "4096"),
-            ("BENCH_COMMIT_VALS", "2000"),
-            ("BENCH_LIGHT_HEADERS", "8"),
-            ("BENCH_LIGHT_VALS", "250"),
-            ("BENCH_SYNC_BLOCKS", "8"),
-            ("BENCH_SYNC_VALS", "125"),
-        ):
-            env.setdefault(k, v)
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child"],
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-            env=env,
-            cwd=REPO,
-        )
-    except subprocess.TimeoutExpired:
-        return None, f"timeout after {timeout:.0f}s"
-    if proc.returncode != 0:
-        tail = (proc.stderr or "").strip().splitlines()[-3:]
-        return None, f"rc={proc.returncode}: " + " | ".join(tail)
-    for line in reversed(proc.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None
-            except json.JSONDecodeError:
-                continue
-    return None, "no JSON line in child output"
-
-
-def _probe_backend(timeout):
-    """Liveness probe: a child that imports jax and runs one tiny jit.
-    Returns None when healthy, else a one-line failure description. A
-    hung accelerator runtime is caught here in TENDERMINT_TPU_PROBE_TIMEOUT
-    seconds instead of burning the full BENCH_TIMEOUT on the real child."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--probe"],
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-            env=dict(os.environ),
-            cwd=REPO,
-        )
-    except subprocess.TimeoutExpired:
-        return f"probe timeout after {timeout:.0f}s"
-    if proc.returncode != 0:
-        tail = (proc.stderr or "").strip().splitlines()[-2:]
-        return f"probe rc={proc.returncode}: " + " | ".join(tail)
-    return None
-
-
-def probe_main() -> None:
-    import jax
-    import jax.numpy as jnp
-
-    x = jax.jit(lambda a: a + 1.0)(jnp.zeros((8,), jnp.float32))
-    x.block_until_ready()
-    print(jax.default_backend(), flush=True)
-
-
-def main() -> None:
-    platform = os.environ.get("JAX_PLATFORMS", "default")
-    probe = {"configured_backend": platform}
-    probe_err = _probe_backend(PROBE_TIMEOUT)
-    if probe_err is not None:
-        _log_probe(
-            f"backend probe on JAX_PLATFORMS={platform} failed: {probe_err}"
-        )
-        result, err = None, probe_err
-    else:
-        result, err = _run_child({}, CHILD_TIMEOUT)
-    if result is None:
-        _log_probe(f"bench child on JAX_PLATFORMS={platform} failed: {err}")
-        probe["primary_failure"] = err
-        result, err2 = _run_child(
-            {"BENCH_FORCE_CPU": "1", "BENCH_ROUNDS": "3"}, CHILD_TIMEOUT
-        )
-        if result is None:
-            _log_probe(f"bench CPU fallback also failed: {err2}")
-            print(
-                json.dumps(
-                    {
-                        "metric": f"ed25519_batch_verify_throughput_b{BATCH}",
-                        "value": 0.0,
-                        "unit": "sigs/s",
-                        "vs_baseline": 0.0,
-                        "probe": {**probe, "fallback_failure": err2},
-                    }
-                )
-            )
-            sys.exit(1)
-        _log_probe(
-            "bench CPU fallback succeeded: %.0f sigs/s" % result.get("value", 0)
-        )
-    else:
-        _log_probe(
-            "bench on JAX_PLATFORMS=%s succeeded: %.0f sigs/s (backend=%s impl=%s)"
-            % (platform, result.get("value", 0), result.get("backend"), result.get("impl"))
-        )
-    result["probe"] = probe
-    print(json.dumps(result))
-
+from bench import runner  # noqa: E402
 
 if __name__ == "__main__":
-    # --impl=mxu|xla|pallas|auto pins the verifier implementation for
-    # both parent and child (the int8-MXU contraction is bench.py
-    # --impl=mxu; default remains auto). Inherited via the environment.
-    for arg in sys.argv[1:]:
-        if arg.startswith("--impl="):
-            impl = arg.split("=", 1)[1]
-            if impl not in ("mxu", "xla", "pallas", "auto"):
-                sys.exit(f"--impl must be one of mxu|xla|pallas|auto, got {impl!r}")
-            os.environ["TENDERMINT_TPU_VERIFY_IMPL"] = impl
-    if "--child" in sys.argv[1:]:
-        child_main()
-    elif "--probe" in sys.argv[1:]:
-        probe_main()
-    else:
-        main()
+    sys.exit(runner.cli(sys.argv[1:]))

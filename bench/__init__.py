@@ -1,0 +1,13 @@
+"""Relay-resilient benchmark harness (ISSUE 6).
+
+``bench.py`` at the repo root is the CLI entry point; this package is
+the implementation:
+
+- ``sections``  — the section registry + measurement bodies
+- ``runner``    — per-section subprocess orchestration, watchdog,
+                  retry/degradation ladder, resume, merged output
+- ``heartbeat`` — child progress spool + parent watchdog
+- ``results``   — partial-result JSON, per-section status, merging
+- ``child``     — the per-section child / backend-probe entry points
+- ``workload``  — shared signature/header fixtures
+"""

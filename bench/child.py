@@ -1,0 +1,77 @@
+"""Section child entry point: run ONE registry section under the
+parent's heartbeat watchdog and print its JSON fragment.
+
+Invoked as ``python bench.py --child-section <name>`` with the spool
+path in ``BENCH_HEARTBEAT_FILE``. The child owns everything that must
+happen before the backend is touched (forced-CPU config, result-cache
+default, tracing mode); the parent owns timeouts, retries, and the
+hook-free environment for forced-CPU runs. One section per process is
+the isolation contract: a wedged backend here takes down exactly this
+measurement, and the next section's child re-probes the backend from
+scratch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from bench import sections
+from bench.heartbeat import HeartbeatWriter
+
+
+def child_main(name: str) -> int:
+    section = sections.get(name)
+    beat = HeartbeatWriter(name)
+
+    # Throughput rounds must measure verification, not dictionary hits:
+    # the digest-keyed result cache would answer rounds 2..N instantly.
+    # Explicit operator env still wins; run_cache re-enables it locally
+    # to report the cache numbers.
+    os.environ.setdefault("TENDERMINT_TPU_RESULT_CACHE", "0")
+    # Span tracing in ring mode: trace summaries come from the spans the
+    # verify pipeline actually emitted. Explicit operator env wins.
+    os.environ.setdefault("TENDERMINT_TPU_TRACE", "ring")
+
+    if section.needs_jax:
+        import jax
+
+        # The axon site hook forces its platform regardless of
+        # JAX_PLATFORMS; only the config knob (applied before first
+        # backend use) overrides it.
+        if os.environ.get("BENCH_FORCE_CPU") == "1":
+            jax.config.update("jax_platforms", "cpu")
+        backend = jax.default_backend()  # first backend use: may wedge
+        # FIRST beat only after the backend answered — until this line
+        # the parent holds the child to the probe window, not the
+        # (longer) heartbeat window.
+        beat("backend ready: %s" % backend)
+    else:
+        beat("start (no jax)")
+
+    from tendermint_tpu.libs import tracing
+
+    tracing.configure()
+    with tracing.tracer.span("bench_section_body", section=name):
+        fragment = section.fn(beat)
+
+    beat("done")
+    print(json.dumps({"section": name, "fragment": fragment}), flush=True)
+    return 0
+
+
+def probe_main() -> int:
+    """Backend liveness probe: import jax and run one tiny jit. The
+    parent holds this child to TENDERMINT_TPU_PROBE_TIMEOUT."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.jit(lambda a: a + 1.0)(jnp.zeros((8,), jnp.float32))
+    x.block_until_ready()
+    print(jax.default_backend(), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(child_main(sys.argv[1]))

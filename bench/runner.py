@@ -1,0 +1,614 @@
+"""Parent orchestration: one subprocess per bench section, each under a
+heartbeat watchdog, results appended to an on-disk partial JSON.
+
+Why this shape (ISSUE 6 / ROADMAP item 2): rounds 2-5 lost their
+real-chip numbers because every measurement ran in ONE child under ONE
+hard timeout — a single wedged kernel compile returned rc=124 and
+zeroed the whole round's evidence. Here each section:
+
+- runs in its own child (``bench.py --child-section <name>``), so a
+  wedge takes down exactly one measurement and the next child gets a
+  fresh backend probe;
+- is watched by heartbeat silence (bench/heartbeat.py), not just
+  wall-clock, with TENDERMINT_TPU_PROBE_TIMEOUT as the first-beat
+  budget;
+- lands in the partial-result file the moment it completes
+  (bench/results.py, atomic rename), so later failures cannot destroy
+  earlier evidence;
+- retries down a degradation ladder (sizes halved per attempt, last
+  rung forced-CPU with the hook-free environment) before giving up
+  with an honest ``timeout``/``crashed`` status;
+- feeds the shared ops/device_policy.DeviceHealth machine: a
+  device-looking failure puts the *device path* in COOLDOWN-style
+  backoff for subsequent sections (they run forced-CPU until the
+  backoff expires and one section becomes the half-open probe) instead
+  of poisoning the rest of the round.
+
+``--resume <partial.json>`` re-runs only sections that are not ``ok``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+from bench import results, sections
+from bench.heartbeat import HEARTBEAT_FILE_ENV, Watchdog
+from bench.workload import REPO, env_float, env_int
+
+BENCH_PY = os.path.join(REPO, "bench.py")
+
+# Degraded-evidence sizes applied when the backend probe already failed
+# and the whole round runs forced-CPU: full-size configs take ~9 min on
+# a loaded CPU (measured); the fallback's job is to land a number, not
+# the headline. Explicit operator env still wins (setdefault).
+CPU_FALLBACK_SIZES = (
+    ("BENCH_BATCH", "4096"),
+    ("BENCH_ROUNDS", "3"),
+    ("BENCH_COMMIT_VALS", "2000"),
+    ("BENCH_LIGHT_HEADERS", "8"),
+    ("BENCH_LIGHT_VALS", "250"),
+    ("BENCH_SYNC_BLOCKS", "8"),
+    ("BENCH_SYNC_VALS", "125"),
+)
+
+
+def _say(msg: str) -> None:
+    # stdout is reserved for the single merged-JSON line (the probe
+    # loop and the round driver both consume it); narration -> stderr.
+    print("bench: %s" % msg, file=sys.stderr, flush=True)
+
+
+def probe_log_path() -> str:
+    return os.environ.get(
+        "BENCH_PROBE_LOG", os.path.join(REPO, "scripts", "TPU_PROBE_LOG.md")
+    )
+
+
+def log_probe(line: str) -> None:
+    try:
+        with open(probe_log_path(), "a") as f:
+            f.write(
+                "- %s — %s\n"
+                % (time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), line)
+            )
+    except OSError:
+        pass
+
+
+# --------------------------------------------------------------------------
+# Config
+# --------------------------------------------------------------------------
+
+
+def section_timeout(name: str) -> float:
+    """Per-section wall budget: BENCH_SECTION_TIMEOUT_<NAME> >
+    BENCH_SECTION_TIMEOUT > legacy BENCH_TIMEOUT (which used to bound
+    the whole single-child run, so it safely bounds any one section)."""
+    per = os.environ.get("BENCH_SECTION_TIMEOUT_%s" % name.upper().lstrip("_"))
+    if per:
+        try:
+            return float(per)
+        except ValueError:
+            pass
+    if os.environ.get("BENCH_SECTION_TIMEOUT"):
+        return env_float("BENCH_SECTION_TIMEOUT", 600.0)
+    if os.environ.get("BENCH_TIMEOUT"):
+        return env_float("BENCH_TIMEOUT", 600.0)
+    return 600.0
+
+
+def heartbeat_timeout() -> float:
+    return env_float("BENCH_HEARTBEAT_TIMEOUT", 180.0)
+
+
+def probe_timeout() -> float:
+    return env_float("TENDERMINT_TPU_PROBE_TIMEOUT", 120.0)
+
+
+def max_attempts() -> int:
+    return max(1, env_int("BENCH_SECTION_ATTEMPTS", 3))
+
+
+def ladder_env(section: sections.Section, attempt: int) -> Dict[str, str]:
+    """Degradation rung for attempt N (1-based): halve every size knob
+    per extra attempt (respecting operator-set bases and floors); the
+    final rung additionally forces the hook-free CPU path, because by
+    then the device path has failed twice."""
+    overrides: Dict[str, str] = {}
+    if attempt <= 1:
+        return overrides
+    factor = 2 ** (attempt - 1)
+    for name, default, floor in section.degrade:
+        base = env_int(name, default)
+        overrides[name] = str(max(floor, base // factor))
+    if attempt >= max_attempts() and section.needs_jax:
+        overrides["BENCH_FORCE_CPU"] = "1"
+    return overrides
+
+
+# --------------------------------------------------------------------------
+# Children
+# --------------------------------------------------------------------------
+
+
+def _hook_free(env: Dict[str, str]) -> Dict[str, str]:
+    """Forced-CPU children must be immune to accelerator site hooks
+    (the axon hook can block ``import jax`` while the TPU relay is
+    down); one shared policy with the dryrun child."""
+    import __graft_entry__
+
+    hook_free = __graft_entry__.hook_free_cpu_env()
+    env["PYTHONPATH"] = hook_free["PYTHONPATH"]
+    env["JAX_PLATFORMS"] = hook_free["JAX_PLATFORMS"]
+    return env
+
+
+def build_child_env(
+    section: sections.Section,
+    overrides: Dict[str, str],
+    spool: str,
+    force_cpu: bool,
+) -> Dict[str, str]:
+    env = dict(os.environ)
+    for key, val in section.extra_env:
+        if key == "XLA_FLAGS":
+            env[key] = ("%s %s" % (env.get(key, ""), val)).strip()
+        else:
+            env[key] = val
+    env.update(overrides)
+    env[HEARTBEAT_FILE_ENV] = spool
+    if force_cpu:
+        env["BENCH_FORCE_CPU"] = "1"
+        env = _hook_free(env)
+    return env
+
+
+def run_probe() -> Optional[str]:
+    """Backend liveness probe child under TENDERMINT_TPU_PROBE_TIMEOUT.
+    Returns None when healthy, else a one-line failure description."""
+    timeout = probe_timeout()
+    try:
+        proc = subprocess.run(
+            [sys.executable, BENCH_PY, "--probe"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=dict(os.environ),
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return "probe timeout after %.0fs" % timeout
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-2:]
+        return "probe rc=%d: %s" % (proc.returncode, " | ".join(tail))
+    return None
+
+
+class AttemptOutcome:
+    __slots__ = ("ok", "fragment", "reason", "stalled", "stderr_tail")
+
+    def __init__(self, ok, fragment=None, reason=None, stalled=False, stderr_tail=""):
+        self.ok = ok
+        self.fragment = fragment
+        self.reason = reason
+        self.stalled = stalled  # watchdog/timeout kill (wedge, not crash)
+        self.stderr_tail = stderr_tail
+
+
+def run_section_child(
+    section: sections.Section, env: Dict[str, str], spool: str
+) -> AttemptOutcome:
+    """One child attempt under the watchdog. Never raises for child
+    misbehavior — every failure mode folds into an AttemptOutcome."""
+    wall = section_timeout(section.name)
+    dog = Watchdog(
+        spool,
+        beat_timeout=heartbeat_timeout(),
+        wall_timeout=wall,
+        # jax sections owe their first beat within the probe budget
+        # (backend import/init); host-only sections just owe beats.
+        startup_timeout=probe_timeout() if section.needs_jax else None,
+    )
+    out_f = tempfile.TemporaryFile(mode="w+")
+    err_f = tempfile.TemporaryFile(mode="w+")
+    kill_reason: Optional[str] = None
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, BENCH_PY, "--child-section", section.name],
+            stdout=out_f,
+            stderr=err_f,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            kill_reason = dog.check()
+            if kill_reason is not None:
+                from tendermint_tpu.libs import tracing
+
+                tracing.instant(
+                    "bench_watchdog_kill",
+                    section=section.name,
+                    reason=kill_reason,
+                )
+                proc.kill()
+                proc.wait()
+                rc = proc.returncode
+                break
+            time.sleep(dog.poll_interval())
+        out_f.seek(0)
+        err_f.seek(0)
+        stdout = out_f.read()
+        stderr = err_f.read()
+    finally:
+        out_f.close()
+        err_f.close()
+    tail = " | ".join((stderr or "").strip().splitlines()[-3:])
+    if kill_reason is not None:
+        return AttemptOutcome(False, reason=kill_reason, stalled=True, stderr_tail=tail)
+    if rc != 0:
+        return AttemptOutcome(
+            False,
+            reason="child rc=%d%s" % (rc, (": " + tail) if tail else ""),
+            stderr_tail=tail,
+        )
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if doc.get("section") == section.name:
+                return AttemptOutcome(True, fragment=doc.get("fragment") or {})
+    return AttemptOutcome(False, reason="no JSON line in child output", stderr_tail=tail)
+
+
+# --------------------------------------------------------------------------
+# Orchestration
+# --------------------------------------------------------------------------
+
+
+def _make_health():
+    """Parent-side DeviceHealth over the *relay*: one transient failure
+    is enough to start the backoff (each section already IS a retry
+    ladder), and the backoff is long enough that a couple of sections
+    run forced-CPU before one is admitted as the half-open probe."""
+    from tendermint_tpu.ops.device_policy import DeviceHealth
+
+    return DeviceHealth(retry_budget=1, cooldown_base=30.0, cooldown_max=240.0)
+
+
+def run_sections(
+    plan: Tuple[str, ...],
+    doc: dict,
+    partial_path: Optional[str],
+) -> dict:
+    """Run every section in ``plan`` (skipping ones already ``ok`` in a
+    resumed ``doc``), recording each outcome into ``doc``/the partial
+    file as it lands. Returns the updated doc."""
+    from tendermint_tpu.libs import tracing
+    from tendermint_tpu.ops import device_policy
+
+    os.environ.setdefault("TENDERMINT_TPU_TRACE", "ring")
+    tracing.configure()
+
+    health = _make_health()
+    needs_jax = any(sections.get(n).needs_jax for n in plan)
+    force_cpu_all = False
+
+    if needs_jax:
+        pending_jax = [
+            n
+            for n in plan
+            if sections.get(n).needs_jax
+            and doc["sections"].get(n, {}).get("status") != results.OK
+        ]
+        if pending_jax:
+            platform = doc.get("configured_backend", "default")
+            _say("probing backend (JAX_PLATFORMS=%s)..." % platform)
+            probe_err = run_probe()
+            if probe_err is not None:
+                log_probe(
+                    "backend probe on JAX_PLATFORMS=%s failed: %s"
+                    % (platform, probe_err)
+                )
+                doc["probe"]["primary_failure"] = probe_err
+                force_cpu_all = True
+                for k, v in CPU_FALLBACK_SIZES:
+                    os.environ.setdefault(k, v)
+                _say("probe failed (%s); whole round runs forced-CPU" % probe_err)
+
+    for name in plan:
+        section = sections.get(name)
+        prior = doc["sections"].get(name)
+        if prior is not None and prior.get("status") == results.OK:
+            _say("section %s: already ok in partial, skipping (resume)" % name)
+            continue
+
+        attempts = max_attempts()
+        t_section = time.monotonic()
+        block = None
+        for attempt in range(1, attempts + 1):
+            overrides = ladder_env(section, attempt)
+            force_cpu = section.needs_jax and (
+                force_cpu_all or overrides.get("BENCH_FORCE_CPU") == "1"
+            )
+            att = None
+            if section.needs_jax and not force_cpu:
+                att = health.begin_attempt(engine="bench")
+                if att is None:
+                    # Relay is cooling down (or disabled): this section
+                    # degrades to CPU instead of feeding a sick device.
+                    force_cpu = True
+                    overrides.setdefault("BENCH_FORCE_CPU", "1")
+            degraded = bool(overrides) or force_cpu
+
+            spool_fd, spool = tempfile.mkstemp(prefix="bench_hb_%s_" % name.lstrip("_"))
+            os.close(spool_fd)
+            try:
+                env = build_child_env(section, overrides, spool, force_cpu)
+                _say(
+                    "section %s: attempt %d/%d%s%s"
+                    % (
+                        name,
+                        attempt,
+                        attempts,
+                        " (forced-CPU)" if force_cpu else "",
+                        " overrides=%s" % overrides if overrides else "",
+                    )
+                )
+                with tracing.tracer.span(
+                    "bench_section",
+                    section=name,
+                    attempt=attempt,
+                    force_cpu=force_cpu,
+                ):
+                    outcome = run_section_child(section, env, spool)
+            finally:
+                try:
+                    os.unlink(spool)
+                except OSError:
+                    pass
+
+            duration = time.monotonic() - t_section
+            if outcome.ok:
+                if att is not None:
+                    health.record_success(att)
+                backend = None
+                frag = outcome.fragment
+                if isinstance(frag, dict):
+                    backend = frag.get("backend") or (
+                        frag.get("multichip") or {}
+                    ).get("backend")
+                if backend is None and force_cpu:
+                    backend = "cpu"
+                block = results.section_block(
+                    results.OK,
+                    attempts=attempt,
+                    duration_s=duration,
+                    degraded=degraded,
+                    note="degraded rung %s" % overrides if degraded and overrides else None,
+                    backend=backend,
+                    result=frag,
+                )
+                break
+
+            # Failure: classify for the relay health machine and retry.
+            if att is not None:
+                exc: BaseException
+                if outcome.stalled:
+                    exc = device_policy.DeviceStallError(outcome.reason or "stall")
+                else:
+                    exc = RuntimeError(outcome.stderr_tail or outcome.reason or "")
+                kind = health.record_failure(exc, att)
+            else:
+                kind = device_policy.classify_failure_text(
+                    outcome.stderr_tail or outcome.reason or ""
+                )
+            _say(
+                "section %s: attempt %d failed (%s, classified %s)"
+                % (name, attempt, outcome.reason, kind)
+            )
+            status = results.TIMEOUT if outcome.stalled else results.CRASHED
+            block = results.section_block(
+                status,
+                attempts=attempt,
+                duration_s=time.monotonic() - t_section,
+                degraded=degraded,
+                note=outcome.reason,
+            )
+
+        assert block is not None
+        results.record_section(doc, partial_path, name, block)
+        log_probe(
+            "section %s: %s in %.1fs (attempts=%d, backend=%s%s)"
+            % (
+                name,
+                block["status"],
+                block["duration_s"],
+                block["attempts"],
+                block.get("backend") or "?",
+                ", degraded" if block.get("degraded") else "",
+            )
+        )
+
+    return doc
+
+
+def mark_skipped(doc: dict, partial_path: Optional[str]) -> None:
+    """Legacy BENCH_SKIP_* opt-outs land as honest ``skipped`` status
+    blocks (the old bench reported them as nulls)."""
+    if os.environ.get("BENCH_SECTIONS", "").strip():
+        return  # an explicit section list is its own statement of scope
+    for name in sections.ORDER:
+        section = sections.get(name)
+        if name in doc["sections"] or name == "_chaos":
+            continue
+        hit = [e for e in section.skip_env if os.environ.get(e) == "1"]
+        if hit:
+            results.record_section(
+                doc,
+                partial_path,
+                name,
+                results.section_block(
+                    results.SKIPPED, attempts=0, duration_s=0.0,
+                    note="%s=1" % hit[0],
+                ),
+            )
+
+
+def run(
+    plan: Optional[Tuple[str, ...]] = None,
+    resume_path: Optional[str] = None,
+    partial_path: Optional[str] = None,
+) -> Tuple[dict, int]:
+    """Full orchestration; returns (merged_doc, exit_code)."""
+    from tendermint_tpu.libs import tracing
+
+    platform = os.environ.get("JAX_PLATFORMS", "default")
+    if resume_path:
+        doc = results.load_partial(resume_path)
+        if partial_path is None:
+            partial_path = resume_path
+    else:
+        doc = results.new_partial(platform)
+        if partial_path is None:
+            partial_path = os.environ.get(
+                "BENCH_PARTIAL", os.path.join(REPO, "BENCH_partial.json")
+            )
+    doc.setdefault("probe", {})["configured_backend"] = platform
+
+    if plan is None:
+        # On resume, finish the round that was interrupted: prefer the
+        # plan recorded in the partial file over today's env/default —
+        # otherwise resuming a BENCH_SECTIONS subset run would widen to
+        # the whole registry.
+        recorded = doc.get("plan")
+        if resume_path and recorded:
+            plan = tuple(n for n in recorded if n in sections.REGISTRY)
+        else:
+            plan = sections.default_plan()
+    doc["plan"] = list(plan)
+
+    run_sections(plan, doc, partial_path)
+    mark_skipped(doc, partial_path)
+
+    merged = results.merge(doc, list(sections.ORDER))
+    merged["runner_trace_summary"] = tracing.tracer.summary() or None
+    code = results.exit_code(doc)
+
+    statuses = [b["status"] for b in doc["sections"].values()]
+    summary = ", ".join(
+        "%d %s" % (statuses.count(s), s)
+        for s in results.STATUSES
+        if statuses.count(s)
+    )
+    log_probe(
+        "bench round on JAX_PLATFORMS=%s: %s — best %.0f sigs/s (backend=%s impl=%s)"
+        % (
+            platform,
+            summary or "nothing ran",
+            merged.get("value") or 0.0,
+            merged.get("backend"),
+            merged.get("impl"),
+        )
+    )
+    _say("done: %s (exit %d); partial at %s" % (summary, code, partial_path))
+    return merged, code
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+
+USAGE = """\
+bench.py — relay-resilient section benchmark runner
+
+  python bench.py                      run every registered section
+  python bench.py --sections a,b       run an explicit subset
+  python bench.py --resume PATH        re-run only failed/missing sections
+  python bench.py --list-sections      show the registry and exit
+  python bench.py --impl=mxu|xla|pallas|auto   pin the verifier impl
+
+Knobs (env): BENCH_SECTION_TIMEOUT[_<NAME>], BENCH_HEARTBEAT_TIMEOUT,
+TENDERMINT_TPU_PROBE_TIMEOUT, BENCH_SECTION_ATTEMPTS, BENCH_SECTIONS,
+BENCH_PARTIAL, BENCH_PROBE_LOG, BENCH_CHAOS (test hook).
+"""
+
+
+def cli(argv: List[str]) -> int:
+    resume_path = None
+    plan: Optional[Tuple[str, ...]] = None
+    partial_path = None
+    args = list(argv)
+    i = 0
+    while i < len(args):
+        arg = args[i]
+        if arg.startswith("--impl="):
+            impl = arg.split("=", 1)[1]
+            if impl not in ("mxu", "xla", "pallas", "auto"):
+                print(
+                    "--impl must be one of mxu|xla|pallas|auto, got %r" % impl,
+                    file=sys.stderr,
+                )
+                return 2
+            os.environ["TENDERMINT_TPU_VERIFY_IMPL"] = impl
+        elif arg == "--probe":
+            from bench.child import probe_main
+
+            return probe_main()
+        elif arg == "--child-section":
+            from bench.child import child_main
+
+            return child_main(args[i + 1])
+        elif arg == "--resume":
+            resume_path = args[i + 1]
+            i += 1
+        elif arg == "--sections":
+            names = tuple(n.strip() for n in args[i + 1].split(",") if n.strip())
+            for n in names:
+                sections.get(n)  # raises on unknown
+            plan = names
+            i += 1
+        elif arg == "--partial":
+            partial_path = args[i + 1]
+            i += 1
+        elif arg == "--list-sections":
+            for name in sections.ORDER:
+                s = sections.get(name)
+                print(
+                    "%-14s needs_jax=%-5s degrade=%s"
+                    % (name, s.needs_jax, [d[0] for d in s.degrade])
+                )
+            return 0
+        elif arg in ("-h", "--help"):
+            print(USAGE)
+            return 0
+        elif arg == "--child":
+            # Pre-ISSUE-6 single-child mode is gone; fail loudly so a
+            # stale driver script can't silently measure nothing.
+            print(
+                "bench.py --child was replaced by per-section children "
+                "(--child-section <name>); run bench.py with no args",
+                file=sys.stderr,
+            )
+            return 2
+        else:
+            print("unknown argument %r\n\n%s" % (arg, USAGE), file=sys.stderr)
+            return 2
+        i += 1
+
+    merged, code = run(plan=plan, resume_path=resume_path, partial_path=partial_path)
+    print(json.dumps(merged))
+    return code

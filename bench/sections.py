@@ -1,0 +1,620 @@
+"""The bench section registry: every measurement the harness knows how
+to run, each as an isolated unit (ISSUE 6 tentpole).
+
+A section body takes a heartbeat callable and returns the *fragment*
+of the headline BENCH JSON it contributes (bench/results.py merges the
+fragments in registry order). Bodies run inside a dedicated child
+process (bench/child.py) under the parent watchdog, so they must beat
+at every unit of real progress — a body that goes silent longer than
+the heartbeat window is presumed wedged and killed.
+
+Degradation ladder: ``degrade`` lists the env knobs the retry ladder
+halves on each re-attempt (floor included), so a section that died at
+full size gets progressively cheaper before the runner gives up
+(bench/runner.py ladder_env).
+
+The ``_chaos`` section is the fault-injection hook for the chaos tests
+and the CI smoke stage: registered only when ``BENCH_CHAOS`` is set,
+its behavior (ok / crash / sigkill / hang / slow / err:<msg>) is the
+env value — a deliberately-misbehaving section the watchdog must
+contain without poisoning its neighbors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Dict, Tuple
+
+from bench.workload import (
+    build_header_chain,
+    env_int,
+    load_helpers,
+    make_workload,
+    mixed_key_factory,
+)
+
+GO_CPU_BATCH_SIGS_PER_SEC = 30_000.0  # curve25519-voi batch verify, 1 core
+
+CHAOS_ENV = "BENCH_CHAOS"
+
+
+@dataclasses.dataclass(frozen=True)
+class Section:
+    """One registry entry. ``degrade`` = ((env_knob, default, floor), ...);
+    ``skip_env`` = legacy BENCH_SKIP_* vars that drop the section;
+    ``extra_env`` = env the parent must add to this section's child."""
+
+    name: str
+    fn: Callable[[Callable[[str], None]], dict]
+    needs_jax: bool = True
+    degrade: Tuple[Tuple[str, int, int], ...] = ()
+    skip_env: Tuple[str, ...] = ()
+    extra_env: Tuple[Tuple[str, str], ...] = ()
+
+
+# --------------------------------------------------------------------------
+# Section bodies
+# --------------------------------------------------------------------------
+
+
+def run_throughput(beat) -> dict:
+    """Headline metric: batched ZIP-215 verification throughput, best of
+    BENCH_ROUNDS rounds at BENCH_BATCH (crypto/ed25519/bench_test.go)."""
+    import jax
+    import numpy as np
+
+    from tendermint_tpu.libs import tracing
+    from tendermint_tpu.ops import ed25519_batch
+
+    batch = env_int("BENCH_BATCH", 8192)
+    rounds = env_int("BENCH_ROUNDS", 5)
+    backend = jax.default_backend()
+    beat("workload batch=%d" % batch)
+    rng = np.random.default_rng(1234)
+    pks, msgs, sigs = make_workload(rng, batch)
+
+    beat("warmup/compile batch=%d backend=%s" % (batch, backend))
+    oks = ed25519_batch.verify_batch(pks, msgs, sigs)
+    assert all(oks), "benchmark signatures must verify"
+
+    best = 0.0
+    tracing.tracer.clear()  # summarize the measured rounds, not warmup
+    for i in range(rounds):
+        beat("round %d/%d" % (i + 1, rounds))
+        t0 = time.perf_counter()
+        ed25519_batch.verify_batch(pks, msgs, sigs)
+        dt = time.perf_counter() - t0
+        best = max(best, batch / dt)
+    return {
+        "metric": "ed25519_batch_verify_throughput_b%d" % batch,
+        "value": round(best, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(best / GO_CPU_BATCH_SIGS_PER_SEC, 3),
+        "backend": backend,
+        "impl": ed25519_batch.active_impl(),
+        "trace_summary": tracing.tracer.summary() or None,
+    }
+
+
+def run_stages(beat) -> dict:
+    """One instrumented pass: prep / H2D / kernel / D2H wall times."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tendermint_tpu.ops import ed25519_batch
+
+    batch = env_int("BENCH_BATCH", 8192)
+    beat("workload batch=%d" % batch)
+    rng = np.random.default_rng(1234)
+    pks, msgs, sigs = make_workload(rng, batch)
+
+    beat("prep")
+    t0 = time.perf_counter()
+    inputs, host_ok = ed25519_batch.prepare_batch(
+        pks, msgs, sigs, pad_to=ed25519_batch._bucket(len(pks))
+    )
+    t_prep = time.perf_counter() - t0
+
+    m = inputs["pk"].shape[0]
+    chunk = ed25519_batch.CHUNK
+    impl = ed25519_batch.active_impl()
+
+    beat("h2d lanes=%d" % m)
+    t0 = time.perf_counter()
+    dev = []
+    for lo in range(0, m, chunk):
+        hi = min(lo + chunk, m)
+        dev.append(
+            tuple(
+                jax.device_put(jnp.asarray(inputs[k][lo:hi]))
+                for k in ("pk", "r", "s", "k")
+            )
+        )
+    for args in dev:
+        for a in args:
+            a.block_until_ready()
+    t_h2d = time.perf_counter() - t0
+
+    fns = []
+    for ci, args in enumerate(dev):
+        n_chunk = args[0].shape[0]
+        beat("kernel compile chunk %d/%d n=%d impl=%s" % (ci + 1, len(dev), n_chunk, impl))
+        if impl == "pallas":
+            from tendermint_tpu.ops import pallas_verify
+
+            fns.append(pallas_verify.compiled_verify(n_chunk))
+        else:
+            from tendermint_tpu.ops import field32
+
+            mul_impl = "mxu" if impl == "mxu" else field32.get_mul_impl()
+            fns.append(ed25519_batch._compiled_kernel(n_chunk, None, mul_impl))
+    beat("kernel warmup")
+    outs = [fn(*args) for fn, args in zip(fns, dev)]  # warmup/compile
+    for o in outs:
+        o.block_until_ready()
+
+    beat("kernel measured pass")
+    t0 = time.perf_counter()
+    outs = [fn(*args) for fn, args in zip(fns, dev)]
+    for o in outs:
+        o.block_until_ready()
+    t_kernel = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    _ = np.concatenate([np.asarray(o) for o in outs])
+    t_d2h = time.perf_counter() - t0
+
+    return {
+        "impl": impl,
+        "backend": jax.default_backend(),
+        "stages_ms": {
+            "prep_ms": round(t_prep * 1e3, 2),
+            "h2d_ms": round(t_h2d * 1e3, 2),
+            "kernel_ms": round(t_kernel * 1e3, 2),
+            "d2h_ms": round(t_d2h * 1e3, 2),
+        },
+    }
+
+
+def run_verify_commit(beat) -> dict:
+    """p50 end-to-end VerifyCommit latency at BENCH_COMMIT_VALS
+    validators (types/validation.go:27-54 semantics; BASELINE.md
+    tracked metric). BENCH_COMMIT_MIX=mixed makes the set half
+    ed25519 / half sr25519."""
+    from tendermint_tpu.types import validation
+
+    n_vals = env_int("BENCH_COMMIT_VALS", 10_000)
+    iters = 7
+    helpers = load_helpers()
+    beat("fixture vals=%d" % n_vals)
+    if os.environ.get("BENCH_COMMIT_MIX", "ed") == "mixed":
+        privs, vset = helpers.make_validators(n_vals, key_factory=mixed_key_factory)
+    else:
+        privs, vset = helpers.make_validators(n_vals)
+    block_id = helpers.make_block_id()
+    commit = helpers.make_commit(block_id, 5, 0, vset, privs)
+    beat("warmup/compile vals=%d" % n_vals)
+    validation.verify_commit(helpers.CHAIN_ID, vset, block_id, 5, commit)
+    times = []
+    for i in range(iters):
+        beat("iter %d/%d" % (i + 1, iters))
+        t0 = time.perf_counter()
+        validation.verify_commit(helpers.CHAIN_ID, vset, block_id, 5, commit)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    p50 = round(times[len(times) // 2] * 1e3, 2)
+    return {"verify_commit_p50_ms_v%d" % n_vals: p50}
+
+
+def run_light_client(beat) -> dict:
+    """BASELINE config 3: light-client sequential chain walk — each step
+    a VerifyAdjacent (valhash link + 2/3 commit verify on the device
+    batch path). Match: light/client_benchmark_test.go,
+    light/verifier.go:106-152."""
+    from tendermint_tpu.encoding.canonical import Timestamp
+    from tendermint_tpu.light.verifier import verify_adjacent
+
+    n_headers = env_int("BENCH_LIGHT_HEADERS", 16)
+    n_vals = env_int("BENCH_LIGHT_VALS", 1000)
+    beat("chain fixture headers=%d vals=%d" % (n_headers, n_vals))
+    chain, vset, _ = build_header_chain(n_headers, n_vals)
+    now = Timestamp.from_unix_ns(
+        1_700_000_000_000_000_000 + (n_headers + 2) * 1_000_000_000
+    )
+
+    def walk():
+        for i in range(1, len(chain)):
+            verify_adjacent(chain[i - 1], chain[i], vset, 86400.0, now, 10.0)
+
+    beat("warmup walk")
+    walk()
+    beat("measured walk")
+    t0 = time.perf_counter()
+    walk()
+    dt = time.perf_counter() - t0
+    return {
+        "light_client_headers_per_s_v%d" % n_vals: round((len(chain) - 1) / dt, 2)
+    }
+
+
+def run_blocksync(beat) -> dict:
+    """BASELINE config 4: a blocksync catch-up window's commits
+    flattened into one pipelined device batch. Match:
+    internal/blocksync/reactor.go:538-650, parallel/pipeline.py."""
+    from tendermint_tpu.parallel.pipeline import CommitTask, verify_commits_pipelined
+
+    n_blocks = env_int("BENCH_SYNC_BLOCKS", 32)
+    n_vals = env_int("BENCH_SYNC_VALS", 500)
+    beat("chain fixture blocks=%d vals=%d" % (n_blocks, n_vals))
+    chain, vset, chain_id = build_header_chain(n_blocks, n_vals)
+    tasks = [
+        CommitTask(chain_id, vset, sh.commit.block_id, sh.header.height, sh.commit)
+        for sh in chain
+    ]
+    beat("warmup pipeline")
+    verdicts = verify_commits_pipelined(tasks)
+    assert all(v.ok for v in verdicts), "benchmark commits must verify"
+    beat("measured pipeline")
+    t0 = time.perf_counter()
+    verdicts = verify_commits_pipelined(tasks)
+    dt = time.perf_counter() - t0
+    assert all(v.ok for v in verdicts)
+    return {"blocksync_blocks_per_s_v%d" % n_vals: round(n_blocks / dt, 2)}
+
+
+def run_cache(beat) -> dict:
+    """Second-commit amortization at BENCH_CACHE_VALS validators: pass 1
+    pays the host-side precompute builds, pass 2 gathers every table
+    from the validator-set cache; passes 3/4 show the digest-keyed
+    result-cache short-circuit."""
+    from tendermint_tpu.ops import precompute
+    from tendermint_tpu.types import validation
+
+    cache_vals = env_int("BENCH_CACHE_VALS", 100)
+    helpers = load_helpers()
+    beat("fixture vals=%d" % cache_vals)
+    privs, vset = helpers.make_validators(cache_vals)
+    block_id = helpers.make_block_id()
+    commit = helpers.make_commit(block_id, 7, 0, vset, privs)
+    precompute.reset()
+
+    def one_pass():
+        t0 = time.perf_counter()
+        validation.verify_commit(helpers.CHAIN_ID, vset, block_id, 7, commit)
+        return time.perf_counter() - t0
+
+    beat("cold pass (compiles + builds tables)")
+    cold = one_pass()
+    s1 = dict(precompute.stats()["precompute"])
+    beat("warm pass (cache gather)")
+    warm = one_pass()
+    s2 = dict(precompute.stats()["precompute"])
+    prev = os.environ.get("TENDERMINT_TPU_RESULT_CACHE")
+    os.environ["TENDERMINT_TPU_RESULT_CACHE"] = "1"
+    try:
+        beat("result-cache passes")
+        one_pass()  # populates the result cache
+        cached = one_pass()  # answered from it
+    finally:
+        if prev is None:
+            os.environ.pop("TENDERMINT_TPU_RESULT_CACHE", None)
+        else:
+            os.environ["TENDERMINT_TPU_RESULT_CACHE"] = prev
+    rc = precompute.stats()["result_cache"]
+    warm_lookups = s2["hits"] + s2["misses"] - s1["hits"] - s1["misses"]
+    warm_hits = s2["hits"] - s1["hits"]
+    return {
+        "cache": {
+            "vals": cache_vals,
+            "cold_ms": round(cold * 1e3, 2),
+            "warm_ms": round(warm * 1e3, 2),
+            "result_cached_ms": round(cached * 1e3, 2),
+            "builds_cold": s1["builds"],
+            "builds_warm": s2["builds"] - s1["builds"],
+            "table_hit_rate_warm": round(warm_hits / warm_lookups, 4)
+            if warm_lookups
+            else None,
+            "table_build_ms_total": round(s2["build_seconds"] * 1e3, 2),
+            "result_cache_hits": rc["hits"],
+            "result_cache_misses": rc["misses"],
+        }
+    }
+
+
+def run_verifyd(beat) -> dict:
+    """Verification-as-a-service cost: an in-process verifyd daemon
+    serves BENCH_VERIFYD_CLIENTS concurrent clients over the localhost
+    wire; the identical batch runs through the tiered dispatch directly
+    for the wire-overhead comparison."""
+    import threading
+
+    import numpy as np
+
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.verifyd import protocol
+    from tendermint_tpu.verifyd.client import VerifydClient
+    from tendermint_tpu.verifyd.server import VerifydServer
+
+    n_clients = env_int("BENCH_VERIFYD_CLIENTS", 4)
+    n_lanes = env_int("BENCH_VERIFYD_LANES", 64)
+    n_rounds = env_int("BENCH_VERIFYD_ROUNDS", 8)
+
+    beat("workload lanes=%d" % n_lanes)
+    rng = np.random.default_rng(99)
+    pks, msgs, sigs = make_workload(rng, n_lanes)
+
+    beat("in-process warmup/compile")
+    crypto_batch.tiered_verify_ed25519(pks, msgs, sigs)
+    t0 = time.perf_counter()
+    for _ in range(n_rounds):
+        crypto_batch.tiered_verify_ed25519(pks, msgs, sigs)
+    inproc_s = (time.perf_counter() - t0) / n_rounds
+
+    srv = VerifydServer(max_batch=n_lanes * n_clients, max_delay=0.002)
+    srv.start()
+    host, port = srv.address
+    lat = []
+    lat_mtx = threading.Lock()
+    errors = []
+
+    def run_client(i):
+        try:
+            c = VerifydClient(f"{host}:{port}", fallback=False)
+            for _ in range(n_rounds):
+                t = time.perf_counter()
+                oks = c.verify(pks, msgs, sigs, klass=protocol.CLASS_CONSENSUS)
+                dt = time.perf_counter() - t
+                if not all(oks):
+                    raise AssertionError("verifyd rejected valid lanes")
+                with lat_mtx:
+                    lat.append(dt)
+            c.close()
+        except Exception as exc:
+            errors.append(repr(exc))
+
+    try:
+        beat("daemon warmup")
+        warm = VerifydClient(f"{host}:{port}")
+        warm.verify(pks, msgs, sigs)
+        warm.close()
+        threads = [
+            threading.Thread(target=run_client, args=(i,))
+            for i in range(n_clients)
+        ]
+        beat("wire rounds clients=%d rounds=%d" % (n_clients, n_rounds))
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors or not lat:
+            return {"verifyd": {"error": errors[:3] or ["no samples"]}}
+        sched = srv.scheduler
+        lat.sort()
+        total_lanes = len(lat) * n_lanes
+        return {
+            "verifyd": {
+                "clients": n_clients,
+                "lanes_per_call": n_lanes,
+                "wire_sigs_per_s": round(total_lanes / wall, 1),
+                "wire_p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+                "wire_p95_ms": round(lat[int(len(lat) * 0.95)] * 1e3, 2),
+                "inproc_batch_ms": round(inproc_s * 1e3, 2),
+                "wire_overhead_x": round((sum(lat) / len(lat)) / inproc_s, 2)
+                if inproc_s > 0
+                else None,
+                "flushes": sched.flushes,
+                "mean_batch_occupancy": round(
+                    sched.entries_verified / max(1, sched.flushes), 1
+                ),
+                "cross_client_flushes": dict(srv.cross_client_flushes),
+            }
+        }
+    finally:
+        srv.stop()
+
+
+def run_multichip(beat) -> dict:
+    """Lane-axis sharded verification over the full device mesh
+    (parallel/sharding.py): ROADMAP item 1's scaling axis, measured as
+    its own section so a sick mesh cannot take the single-chip evidence
+    down with it. On a CPU backend the parent injects
+    ``--xla_force_host_platform_device_count`` so the virtual 8-mesh is
+    exercised (same mechanism as __graft_entry__.dryrun_multichip)."""
+    import jax
+    import numpy as np
+
+    from tendermint_tpu.parallel import sharding
+
+    lanes = env_int("BENCH_MULTICHIP_LANES", 2048)
+    beat("mesh discovery")
+    mesh = sharding.make_mesh()
+    n_dev = int(mesh.devices.size)
+    beat("workload lanes=%d devices=%d" % (lanes, n_dev))
+    rng = np.random.default_rng(7)
+    pks, msgs, sigs = make_workload(rng, lanes)
+    sigs[3] = b"\x01" * 64  # one injected bad lane: verdicts must be real
+
+    beat("sharded warmup/compile devices=%d" % n_dev)
+    oks = sharding.verify_batch_sharded(pks, msgs, sigs, mesh=mesh)
+    ok_shape = (not oks[3]) and all(oks[:3]) and all(oks[4:])
+    beat("sharded measured pass")
+    t0 = time.perf_counter()
+    sharding.verify_batch_sharded(pks, msgs, sigs, mesh=mesh)
+    dt = time.perf_counter() - t0
+    return {
+        "multichip": {
+            "devices": n_dev,
+            "backend": jax.default_backend(),
+            "lanes": lanes,
+            "sigs_per_s": round(lanes / dt, 1),
+            "ok": bool(ok_shape),
+        }
+    }
+
+
+def run_host_ref(beat) -> dict:
+    """Pure-python ZIP-215 reference throughput (crypto/ed25519_ref) —
+    the no-jax floor every device number is compared against, and the
+    section the chaos tests / CI smoke lean on because it cannot be
+    taken down by the accelerator stack."""
+    from tendermint_tpu.crypto import ed25519_ref
+
+    n = env_int("BENCH_HOST_REF_SIGS", 12)
+    beat("keygen n=%d" % n)
+    triples = []
+    for i in range(n):
+        sk, pk = ed25519_ref.generate_keypair()
+        msg = b"bench-host-ref-%d" % i
+        triples.append((pk, msg, ed25519_ref.sign(sk, msg)))
+    beat("verify n=%d" % n)
+    t0 = time.perf_counter()
+    oks = [ed25519_ref.verify_zip215(pk, m, s) for pk, m, s in triples]
+    dt = time.perf_counter() - t0
+    assert all(oks), "host reference verification must pass"
+    return {"host_ref": {"sigs": n, "sigs_per_s": round(n / dt, 1)}}
+
+
+def run_chaos(beat) -> dict:
+    """Fault injection (BENCH_CHAOS): the section that misbehaves on
+    purpose so tests and the CI smoke stage can prove the runner
+    contains it. Modes:
+
+    - ``ok``         complete normally
+    - ``crash``      raise (child exits non-zero)
+    - ``err:<msg>``  raise RuntimeError(msg) — classification tests
+    - ``sigkill``    SIGKILL self mid-run (torn child, no traceback)
+    - ``hang``       beat once, then go silent — heartbeat-watchdog prey
+    - ``slow:<s>``   beat dutifully for <s> seconds — wall-timeout prey
+    """
+    import signal
+
+    mode = os.environ.get(CHAOS_ENV, "ok")
+    beat("chaos mode=%s" % mode)
+    if mode == "ok":
+        return {"chaos": {"mode": "ok"}}
+    if mode == "crash":
+        raise RuntimeError("injected chaos crash")
+    if mode.startswith("err:"):
+        raise RuntimeError(mode[4:])
+    if mode == "sigkill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    if mode == "hang":
+        # Deliberate heartbeat silence: the watchdog, not this sleep,
+        # decides when this section dies.
+        time.sleep(3600)
+        return {"chaos": {"mode": "hang-survived"}}
+    if mode.startswith("slow:"):
+        deadline = time.monotonic() + float(mode[5:])
+        i = 0
+        while time.monotonic() < deadline:
+            i += 1
+            beat("slow tick %d" % i)
+            time.sleep(0.1)
+        return {"chaos": {"mode": mode, "ticks": i}}
+    raise ValueError("unknown BENCH_CHAOS mode %r" % mode)
+
+
+# --------------------------------------------------------------------------
+# Registry
+# --------------------------------------------------------------------------
+
+_ALL = (
+    Section(
+        "throughput",
+        run_throughput,
+        degrade=(("BENCH_BATCH", 8192, 256), ("BENCH_ROUNDS", 5, 2)),
+    ),
+    Section("stages", run_stages, degrade=(("BENCH_BATCH", 8192, 256),)),
+    Section(
+        "verify_commit",
+        run_verify_commit,
+        degrade=(("BENCH_COMMIT_VALS", 10_000, 100),),
+        skip_env=("BENCH_SKIP_COMMIT",),
+    ),
+    Section(
+        "light_client",
+        run_light_client,
+        degrade=(
+            ("BENCH_LIGHT_HEADERS", 16, 4),
+            ("BENCH_LIGHT_VALS", 1000, 50),
+        ),
+        skip_env=("BENCH_SKIP_EXTRAS",),
+    ),
+    Section(
+        "blocksync",
+        run_blocksync,
+        degrade=(("BENCH_SYNC_BLOCKS", 32, 4), ("BENCH_SYNC_VALS", 500, 50)),
+        skip_env=("BENCH_SKIP_EXTRAS",),
+    ),
+    Section(
+        "cache",
+        run_cache,
+        degrade=(("BENCH_CACHE_VALS", 100, 25),),
+        skip_env=("BENCH_SKIP_CACHE",),
+    ),
+    Section(
+        "verifyd",
+        run_verifyd,
+        degrade=(
+            ("BENCH_VERIFYD_LANES", 64, 16),
+            ("BENCH_VERIFYD_ROUNDS", 8, 2),
+        ),
+        skip_env=("BENCH_SKIP_VERIFYD",),
+    ),
+    Section(
+        "multichip",
+        run_multichip,
+        degrade=(("BENCH_MULTICHIP_LANES", 2048, 256),),
+        skip_env=("BENCH_SKIP_MULTICHIP",),
+        # Virtual 8-mesh on the host platform; inert on a real device
+        # backend (the flag only shapes the CPU platform).
+        extra_env=(
+            (
+                "XLA_FLAGS",
+                "--xla_force_host_platform_device_count=8",
+            ),
+        ),
+    ),
+    Section("host_ref", run_host_ref, needs_jax=False),
+    Section("_chaos", run_chaos, needs_jax=False),
+)
+
+REGISTRY: Dict[str, Section] = {s.name: s for s in _ALL}
+
+# Registry order is merge order (bench/results.py) and run order.
+ORDER = tuple(s.name for s in _ALL)
+
+
+def default_plan() -> Tuple[str, ...]:
+    """The sections a plain ``python bench.py`` runs: everything except
+    the chaos hook (present only when BENCH_CHAOS asks for it), minus
+    legacy BENCH_SKIP_* opt-outs, or exactly BENCH_SECTIONS when set."""
+    explicit = os.environ.get("BENCH_SECTIONS", "").strip()
+    if explicit:
+        names = [n.strip() for n in explicit.split(",") if n.strip()]
+        unknown = [n for n in names if n not in REGISTRY]
+        if unknown:
+            raise KeyError("unknown bench section(s): %s" % ", ".join(unknown))
+        return tuple(names)
+    plan = []
+    for s in _ALL:
+        if s.name == "_chaos" and not os.environ.get(CHAOS_ENV):
+            continue
+        if any(os.environ.get(e) == "1" for e in s.skip_env):
+            continue
+        plan.append(s.name)
+    return tuple(plan)
+
+
+def get(name: str) -> Section:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            "unknown bench section %r (have: %s)" % (name, ", ".join(ORDER))
+        ) from None

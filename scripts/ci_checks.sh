@@ -10,7 +10,7 @@ set -u
 rc_total=0
 
 echo "== compileall =="
-python -m compileall -q tendermint_tpu tests scripts bench.py || rc_total=1
+python -m compileall -q tendermint_tpu tests scripts bench bench.py || rc_total=1
 
 echo "== analysis (tpulint) =="
 # project-specific static analysis: lock discipline, JAX purity,
@@ -37,6 +37,47 @@ fi
 
 echo "== check_metrics =="
 python scripts/check_metrics.py || rc_total=1
+
+echo "== bench smoke (section runner vs a hanging section) =="
+# The relay-resilience contract (ISSUE 6): one deliberately-hanging
+# section must NOT zero the round. Tiny no-jax sections keep this
+# stage fast; the injected hang must die by heartbeat watchdog (well
+# under the 60s wall budget), the run must not end in a whole-run
+# rc=124, and the partial JSON must carry the healthy section's number.
+rm -rf /tmp/_bench_smoke && mkdir -p /tmp/_bench_smoke
+timeout -k 10 120 env JAX_PLATFORMS=cpu \
+    BENCH_SECTIONS=host_ref,_chaos BENCH_CHAOS=hang \
+    BENCH_HEARTBEAT_TIMEOUT=5 BENCH_SECTION_TIMEOUT=60 \
+    BENCH_SECTION_ATTEMPTS=1 BENCH_HOST_REF_SIGS=4 \
+    BENCH_PARTIAL=/tmp/_bench_smoke/partial.json \
+    BENCH_PROBE_LOG=/tmp/_bench_smoke/probe.md \
+    python bench.py > /tmp/_bench_smoke/out.json 2>/tmp/_bench_smoke/err.log
+bench_rc=$?
+if [ "$bench_rc" -eq 124 ]; then
+    echo "bench smoke: whole-run timeout (rc=124) — section isolation broken" >&2
+    rc_total=1
+elif [ "$bench_rc" -ne 3 ]; then
+    # 3 = partial evidence (healthy sections ok, the injected hang honest)
+    echo "bench smoke: expected partial-evidence rc=3, got rc=$bench_rc" >&2
+    tail -5 /tmp/_bench_smoke/err.log >&2
+    rc_total=1
+fi
+python - <<'EOF' || rc_total=1
+import json
+merged = json.load(open("/tmp/_bench_smoke/out.json"))
+secs = merged["sections"]
+assert secs["host_ref"]["status"] == "ok", secs
+assert merged["host_ref"]["sigs_per_s"] > 0, merged
+assert secs["_chaos"]["status"] == "timeout", secs
+assert "heartbeat silence" in (secs["_chaos"]["note"] or ""), secs
+# killed by the heartbeat watchdog inside its window, not the wall budget
+assert secs["_chaos"]["duration_s"] < 30, secs
+json.load(open("/tmp/_bench_smoke/partial.json"))  # schema-valid on disk
+print(
+    "bench smoke ok: hang killed by watchdog in %.1fs, healthy section kept"
+    % secs["_chaos"]["duration_s"]
+)
+EOF
 
 echo "== tier-1 pytest =="
 set -o pipefail

@@ -65,15 +65,25 @@ PERMANENT = "permanent"
 # Specific backend-initialization signatures that mean no jax backend
 # can come up in this process at all (e.g. the axon plugin failing to
 # register in a subprocess). Anything else — OOMs, flaky launches,
-# transport resets — is transient and consumes the retry budget.
+# transport resets — is transient and consumes the retry budget. Each
+# pattern pins the *shape* jax actually raises with, not a keyword: a
+# transient hiccup that merely mentions "backend" or "platform"
+# ("unknown backend configuration flag", "transfer to platform device
+# timed out") must never disable the device path for the process
+# lifetime (ROADMAP known debt; regression tests in
+# tests/test_device_policy.py).
 _PERMANENT_PATTERNS = [
     re.compile(p)
     for p in (
         r"unable to initialize backend",
-        r"backend '\w+' failed to initialize",
-        r"unknown backend",
+        r"backend '[\w-]+' failed to initialize",
+        # jax's xla_bridge raises "Unknown backend: 'tpu' requested, ..."
+        # / "Unknown backend tpu" — the backend NAME must follow, so
+        # prose that happens to contain "unknown backend" stays transient.
+        r"unknown backend:? '[\w-]+'",
+        r"^unknown backend [\w-]+$",
         r"no devices? found for platform",
-        r"platform '\w+' is not registered",
+        r"platform '[\w-]+' is not registered",
     )
 ]
 
@@ -82,6 +92,17 @@ class DeviceStallError(RuntimeError):
     """A device call that never returned (wedge, not an exception) —
     reported by watchdogs like the VotePreverifier's deadline tracking
     so other callers stop feeding a hung device. Always transient."""
+
+
+def classify_failure_text(text: str) -> str:
+    """TRANSIENT or PERMANENT for a failure only known by its text —
+    e.g. the stderr tail of a dead bench section child (bench/runner.py),
+    where the exception object died with the subprocess. Permanent iff
+    the text carries one of the specific backend-init signatures."""
+    lowered = text.lower()
+    if any(p.search(lowered) for p in _PERMANENT_PATTERNS):
+        return PERMANENT
+    return TRANSIENT
 
 
 def classify_failure(exc: BaseException) -> str:
@@ -98,9 +119,7 @@ def classify_failure(exc: BaseException) -> str:
     if isinstance(exc, ImportError):
         return PERMANENT
     if isinstance(exc, RuntimeError):
-        text = str(exc).lower()
-        if any(p.search(text) for p in _PERMANENT_PATTERNS):
-            return PERMANENT
+        return classify_failure_text(str(exc))
     return TRANSIENT
 
 

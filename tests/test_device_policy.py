@@ -94,6 +94,60 @@ def test_explicit_permanent_attribute_wins():
     assert classify_failure(err) == TRANSIENT
 
 
+@pytest.mark.parametrize(
+    "msg",
+    [
+        # ROADMAP known debt: transient XLA/runtime hiccups that merely
+        # MENTION "backend"/"platform" must never be classified as a
+        # permanent init failure (the old substring matching was too
+        # broad — one relay blip disabled the device path for the
+        # process lifetime).
+        "transfer to platform device timed out",
+        "backend compile deadline exceeded on worker 0",
+        "unknown backend configuration flag --xla_foo ignored",
+        "the backend returned RESOURCE_EXHAUSTED while allocating 2.1G",
+        "stream executor platform reported a transient DMA error",
+        "platform event pool exhausted; retry the launch",
+        "backend 'tpu' heartbeat lost; reconnecting",
+        "watchdog: no response from backend within 30s",
+    ],
+)
+def test_backend_platform_mentions_stay_transient(msg):
+    assert classify_failure(RuntimeError(msg)) == TRANSIENT
+
+
+@pytest.mark.parametrize(
+    "msg",
+    [
+        # ...while the specific jax backend-INIT signatures stay
+        # permanent, in the exact shapes xla_bridge raises them.
+        "Unable to initialize backend 'tpu': UNAVAILABLE: no TPU found",
+        "Backend 'axon' failed to initialize: relay socket refused",
+        "Unknown backend: 'tpu' requested, but no platforms are present",
+        "unknown backend axon",
+        "No devices found for platform tpu",
+        "platform 'axon' is not registered",
+    ],
+)
+def test_backend_init_signatures_stay_permanent(msg):
+    assert classify_failure(RuntimeError(msg)) == PERMANENT
+
+
+def test_classify_failure_text_matches_exception_classification():
+    """bench/runner.py classifies dead section children by their stderr
+    tail; the text path must agree with the exception path."""
+    from tendermint_tpu.ops.device_policy import classify_failure_text
+
+    for msg, want in [
+        ("RuntimeError: Unable to initialize backend 'tpu': gone", PERMANENT),
+        ("jaxlib.xla_extension.XlaRuntimeError: transfer timed out", TRANSIENT),
+        ("unknown backend configuration flag", TRANSIENT),
+        ("", TRANSIENT),
+    ]:
+        assert classify_failure_text(msg) == want, msg
+        assert classify_failure(RuntimeError(msg)) == want, msg
+
+
 # --- state machine unit tests (fake clock, no device) ------------------------
 
 

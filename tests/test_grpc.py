@@ -542,6 +542,182 @@ def test_strip_padding_rejects_malformed():
     assert _strip_padding(FLAG_PADDED, b"\x03\x00\x00\x00") == b""
 
 
+# --- server loop: split header blocks and padded frames ----------------------
+# ROADMAP known debt (ISSUE 6 satellite): pin that PR 5's hardening of
+# the SERVER loop holds for the same frame shapes the client loop was
+# hardened against — END_STREAM riding a HEADERS frame whose block only
+# finishes in a CONTINUATION, and PADDED/PRIORITY decoration.
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise AssertionError("server closed the connection early")
+        buf += chunk
+    return buf
+
+
+def _read_response(sock, dec):
+    """Read server frames until trailers carrying grpc-status; returns
+    (status, concatenated DATA payload)."""
+    from tendermint_tpu.libs.grpc import FRAME_DATA, FRAME_HEADERS
+
+    data = b""
+    while True:
+        head = _recv_exact(sock, 9)
+        length = int.from_bytes(b"\x00" + head[:3], "big")
+        ftype = head[3]
+        payload = _recv_exact(sock, length) if length else b""
+        if ftype == FRAME_HEADERS:
+            hdrs = dict(dec.decode(payload))
+            if "grpc-status" in hdrs:
+                return int(hdrs["grpc-status"]), data
+        elif ftype == FRAME_DATA:
+            data += payload
+
+
+def _raw_echo_conn():
+    """(driver socket, server thread, server, decoder) — a live echo
+    GrpcServer connection fed by hand-rolled frames."""
+    import socket as socketlib
+
+    from tendermint_tpu.libs.grpc import PREFACE
+
+    srv = GrpcServer({"/t.Svc/Echo": lambda p: p}, port=0)
+    a, b = socketlib.socketpair()
+    a.settimeout(5)
+    b.settimeout(5)
+    t = threading.Thread(target=srv._serve_conn, args=(a,), daemon=True)
+    t.start()
+    b.sendall(PREFACE)
+    return b, t, srv, HpackDecoder()
+
+
+def test_server_request_headers_split_across_continuation_echoes():
+    """Request header block split into HEADERS + CONTINUATION (END_HEADERS
+    only on the CONTINUATION), body in a DATA frame: the server must
+    assemble the block before dispatch and serve the call normally."""
+    from tendermint_tpu.libs.grpc import (
+        FLAG_END_HEADERS,
+        FLAG_END_STREAM,
+        FRAME_CONTINUATION,
+        FRAME_DATA,
+        FRAME_HEADERS,
+        grpc_frame,
+        grpc_unframe,
+    )
+
+    b, t, srv, dec = _raw_echo_conn()
+    try:
+        block = hpack_encode([(":method", "POST"), (":path", "/t.Svc/Echo")])
+        b.sendall(_frame_bytes(FRAME_HEADERS, 0, 1, block[:3]))
+        b.sendall(
+            _frame_bytes(FRAME_CONTINUATION, FLAG_END_HEADERS, 1, block[3:])
+        )
+        b.sendall(
+            _frame_bytes(FRAME_DATA, FLAG_END_STREAM, 1, grpc_frame(b"ping"))
+        )
+        status, data = _read_response(b, dec)
+        assert status == 0
+        assert grpc_unframe(data) == b"ping"
+    finally:
+        b.close()
+        t.join(timeout=5)
+        srv.stop()
+
+
+def test_server_end_stream_before_end_headers_dispatches_once_decoded():
+    """END_STREAM rides the HEADERS frame but the block finishes in a
+    CONTINUATION: the server must hold the dispatch until END_HEADERS
+    (the empty-body call errors *inside* gRPC, with trailers), and the
+    connection must stay usable for the next call — honoring END_STREAM
+    early or dropping it would either crash the loop or hang the
+    stream."""
+    from tendermint_tpu.libs.grpc import (
+        FLAG_END_HEADERS,
+        FLAG_END_STREAM,
+        FRAME_CONTINUATION,
+        FRAME_DATA,
+        FRAME_HEADERS,
+        GRPC_INTERNAL,
+        grpc_frame,
+        grpc_unframe,
+    )
+
+    b, t, srv, dec = _raw_echo_conn()
+    try:
+        block = hpack_encode([(":method", "POST"), (":path", "/t.Svc/Echo")])
+        # stream 1: END_STREAM first, END_HEADERS later, no body
+        b.sendall(_frame_bytes(FRAME_HEADERS, FLAG_END_STREAM, 1, block[:4]))
+        b.sendall(
+            _frame_bytes(FRAME_CONTINUATION, FLAG_END_HEADERS, 1, block[4:])
+        )
+        status, _ = _read_response(b, dec)
+        assert status == GRPC_INTERNAL  # empty body = short gRPC message
+        # stream 3: a normal call on the SAME connection still round-trips
+        # (the HPACK dynamic table and stream bookkeeping were not torn)
+        b.sendall(
+            _frame_bytes(FRAME_HEADERS, FLAG_END_HEADERS, 3, block)
+        )
+        b.sendall(
+            _frame_bytes(FRAME_DATA, FLAG_END_STREAM, 3, grpc_frame(b"alive"))
+        )
+        status, data = _read_response(b, dec)
+        assert status == 0
+        assert grpc_unframe(data) == b"alive"
+    finally:
+        b.close()
+        t.join(timeout=5)
+        srv.stop()
+
+
+def test_server_padded_priority_headers_and_padded_data_echo():
+    """PADDED|PRIORITY HEADERS and a PADDED DATA frame: padding and the
+    5-byte priority field must be stripped before HPACK/body assembly."""
+    from tendermint_tpu.libs.grpc import (
+        FLAG_END_HEADERS,
+        FLAG_END_STREAM,
+        FLAG_PADDED,
+        FLAG_PRIORITY,
+        FRAME_DATA,
+        FRAME_HEADERS,
+        grpc_frame,
+        grpc_unframe,
+    )
+
+    b, t, srv, dec = _raw_echo_conn()
+    try:
+        block = hpack_encode([(":method", "POST"), (":path", "/t.Svc/Echo")])
+        pad = b"\x00" * 4
+        priority = b"\x00\x00\x00\x00\x10"  # stream dep 0, weight 16
+        b.sendall(
+            _frame_bytes(
+                FRAME_HEADERS,
+                FLAG_END_HEADERS | FLAG_PADDED | FLAG_PRIORITY,
+                1,
+                bytes([len(pad)]) + priority + block + pad,
+            )
+        )
+        body = grpc_frame(b"pad-me")
+        b.sendall(
+            _frame_bytes(
+                FRAME_DATA,
+                FLAG_END_STREAM | FLAG_PADDED,
+                1,
+                bytes([len(pad)]) + body + pad,
+            )
+        )
+        status, data = _read_response(b, dec)
+        assert status == 0
+        assert grpc_unframe(data) == b"pad-me"
+    finally:
+        b.close()
+        t.join(timeout=5)
+        srv.stop()
+
+
 # --- torn-connection resilience ---------------------------------------------
 
 
