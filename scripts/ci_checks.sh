@@ -38,6 +38,44 @@ fi
 echo "== check_metrics =="
 python scripts/check_metrics.py || rc_total=1
 
+echo "== mesh engine tests (virtual 8-device mesh) =="
+# The sharded verify engine (parallel/mesh + parallel/sharding) under
+# the same virtual 8-mesh tests/conftest.py forces; run as its own
+# stage so a mesh regression is visible even when tier-1 passes.
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m pytest tests/test_mesh.py tests/test_parallel.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc_total=1
+
+echo "== bench smoke (multichip scaling section) =="
+# The multichip section must produce its scaling curve on the virtual
+# mesh and land status=ok in both the merged and partial JSON. Tiny
+# lanes/rounds keep the stage inside the wall budget; the DEFAULT
+# heartbeat window stays (sharded compiles legitimately exceed 5s).
+rm -rf /tmp/_bench_mesh && mkdir -p /tmp/_bench_mesh
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    BENCH_SECTIONS=multichip BENCH_MULTICHIP_LANES=512 \
+    BENCH_MULTICHIP_DEVICES=1,2 BENCH_MULTICHIP_ROUNDS=1 \
+    BENCH_SECTION_TIMEOUT=360 BENCH_SECTION_ATTEMPTS=1 \
+    BENCH_PARTIAL=/tmp/_bench_mesh/partial.json \
+    python bench.py > /tmp/_bench_mesh/out.json 2>/tmp/_bench_mesh/err.log
+if [ "$?" -ne 0 ]; then
+    echo "bench multichip smoke: non-zero rc" >&2
+    tail -5 /tmp/_bench_mesh/err.log >&2
+    rc_total=1
+fi
+python - <<'EOF' || rc_total=1
+import json
+merged = json.load(open("/tmp/_bench_mesh/out.json"))
+assert merged["sections"]["multichip"]["status"] == "ok", merged["sections"]
+mc = merged["multichip"]
+assert mc["ok"] is True, mc
+assert set(mc["sigs_per_s"]) == {"1", "2"}, mc
+partial = json.load(open("/tmp/_bench_mesh/partial.json"))
+assert partial["sections"]["multichip"]["status"] == "ok", partial["sections"]
+print("bench multichip smoke ok: %s" % mc["sigs_per_s"])
+EOF
+
 echo "== bench smoke (section runner vs a hanging section) =="
 # The relay-resilience contract (ISSUE 6): one deliberately-hanging
 # section must NOT zero the round. Tiny no-jax sections keep this

@@ -514,8 +514,10 @@ def cmd_verifyd(args) -> int:
     nodes/light clients. ``--metrics HOST:PORT`` additionally serves the
     Prometheus registry (and /debug/traces) over HTTP."""
     from tendermint_tpu.libs.metrics import Registry, VerifydMetrics
+    from tendermint_tpu.parallel import mesh
     from tendermint_tpu.verifyd.server import VerifydServer
 
+    mesh.manager.configure(args.mesh)
     if args.trace:
         from tendermint_tpu.libs import tracing
 
@@ -549,7 +551,7 @@ def cmd_verifyd(args) -> int:
     shost, sport = server.address
     print(
         f"verifyd serving on {shost}:{sport} "
-        f"(max_batch={args.max_batch}, max_delay={args.max_delay}s, "
+        f"(max_batch={server.max_batch}, max_delay={args.max_delay}s, "
         f"admission_cap={args.admission_cap})",
         flush=True,
     )
@@ -989,8 +991,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="gRPC listen address",
     )
     p.add_argument(
-        "--max-batch", type=int, default=256,
-        help="flush when this many lanes are pending",
+        "--max-batch", type=int, default=None,
+        help="flush when this many lanes are pending "
+        "(default: 256 × mesh devices)",
+    )
+    p.add_argument(
+        "--mesh", type=int, default=0,
+        help="devices the sharded verify engine may span "
+        "(0 = all; 1 disables sharding; TENDERMINT_TPU_MESH applies at 0)",
     )
     p.add_argument(
         "--max-delay", type=float, default=0.002,

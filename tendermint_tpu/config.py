@@ -112,6 +112,10 @@ class OpsConfig:
     # local accelerator. Empty = local verification. The
     # TENDERMINT_TPU_VERIFY_REMOTE env var applies when this is empty.
     verify_remote: str = ""
+    # Devices the sharded verify engine may span (parallel/mesh.py).
+    # 0 = all available devices; 1 disables sharding. The
+    # TENDERMINT_TPU_MESH env var applies when this is 0.
+    mesh_devices: int = 0
 
 
 @dataclass
@@ -189,6 +193,7 @@ class Config:
             double_sign_check_height=self.consensus.double_sign_check_height,
             trace=self.base.trace,
             verify_remote=self.ops.verify_remote,
+            mesh_devices=self.ops.mesh_devices,
         )
 
     # --- TOML ---------------------------------------------------------------

@@ -52,6 +52,20 @@ DEFAULT_MAX_BATCH = 256
 DEFAULT_MAX_DELAY = 0.002  # 2ms: well under a vote round-trip
 
 
+def default_max_batch() -> int:
+    """Size-flush threshold scaled to the verify mesh: with the sharded
+    engine spanning k devices, a super-batch k× the single-device
+    default keeps every chip's slab at the same occupancy one chip saw
+    before. Falls back to the single-device default when the mesh (or
+    its discovery) is unavailable."""
+    try:
+        from tendermint_tpu.parallel import mesh
+
+        return DEFAULT_MAX_BATCH * max(1, mesh.manager.device_count())
+    except Exception:  # discovery trouble must not break scheduler setup
+        return DEFAULT_MAX_BATCH
+
+
 class SchedulerSaturatedError(RuntimeError):
     """Pending queue is at ``max_pending``; shed load explicitly."""
 
@@ -93,7 +107,7 @@ class VerifyScheduler:
         verify_fn: Callable[
             [Sequence[bytes], Sequence[bytes], Sequence[bytes]], List[bool]
         ],
-        max_batch: int = DEFAULT_MAX_BATCH,
+        max_batch: Optional[int] = None,
         max_delay: float = DEFAULT_MAX_DELAY,
         fallback_fn: Optional[
             Callable[
@@ -107,7 +121,9 @@ class VerifyScheduler:
     ):
         self._verify_fn = verify_fn
         self._fallback_fn = fallback_fn
-        self.max_batch = max_batch
+        # None = mesh-aware default: 256 lanes per device the sharded
+        # engine can span, so cross-client super-batches fill the mesh.
+        self.max_batch = default_max_batch() if max_batch is None else max_batch
         self.max_delay = max_delay
         # 0 = unbounded (the historical in-process behavior); a serving
         # front-end sets a cap and maps SchedulerSaturatedError to an

@@ -446,6 +446,33 @@ class OpsMetrics(_NopMixin):
             _name(s, "result_cache_misses_total"),
             "Verifications that missed the digest-keyed result cache.",
         )
+        # Mesh-sharded verify engine (parallel/mesh.py): which mesh the
+        # sharded path is running on and how lanes spread across it.
+        self.mesh_devices = reg.gauge(
+            _name(s, "mesh_devices"),
+            "Devices in the most recently dispatched verify mesh "
+            "(0 = sharding unused).",
+        )
+        self.mesh_dispatches = reg.counter(
+            _name(s, "mesh_dispatches_total"),
+            "Lane-sharded chunk dispatches, by mesh size.",
+            labels=("devices",),
+        )
+        self.mesh_lanes = reg.counter(
+            _name(s, "mesh_lanes_total"),
+            "Padded signature lanes dispatched per device of the mesh.",
+            labels=("device",),
+        )
+        self.mesh_exclusions = reg.counter(
+            _name(s, "mesh_exclusions_total"),
+            "Devices excluded from the mesh after an attributed failure.",
+            labels=("device",),
+        )
+        self.mesh_readmissions = reg.counter(
+            _name(s, "mesh_readmissions_total"),
+            "Excluded devices re-admitted after a successful probe.",
+            labels=("device",),
+        )
         # Per-stage pipeline timing, fed by the tracer's metrics
         # observer (libs/tracing.py): every span tagged stage+engine
         # lands exactly one observation here.

@@ -98,6 +98,10 @@ class NodeConfig:
     # device-worthy signature batches to a verifyd daemon instead of a
     # local accelerator ("" = local verification).
     verify_remote: str = ""
+    # Devices the sharded verify engine may span ([ops] mesh_devices /
+    # the TENDERMINT_TPU_MESH env var): 0 = all available, 1 disables
+    # sharding (parallel/mesh.py).
+    mesh_devices: int = 0
 
 
 class Node:
@@ -308,6 +312,12 @@ class Node:
         from tendermint_tpu.ops import precompute as _precompute
 
         _precompute.bind_metrics(ops_metrics)
+        # And the verify mesh (parallel/mesh.py): apply the configured
+        # device cap and mirror sharded-dispatch activity.
+        from tendermint_tpu.parallel import mesh as _mesh
+
+        _mesh.manager.configure(config.mesh_devices)
+        _mesh.manager.bind_metrics(ops_metrics)
         # Span tracer: honor an explicit config knob (env otherwise), and
         # feed span durations into the stage/step histograms regardless of
         # whether the ring is recording.

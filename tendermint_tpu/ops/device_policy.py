@@ -280,6 +280,17 @@ class DeviceHealth:
                 max(0.0, self._clock() - attempt.started)
             )
 
+    def release_probe(self, attempt: Optional[Attempt]) -> None:
+        """Give back a half-open probe reservation WITHOUT recording an
+        outcome: the admitted attempt was never actually dispatched
+        (e.g. the mesh planner reserved a probe slot but the batch took
+        another path). Without this the one-prober latch would stay set
+        forever and the device could never be re-admitted."""
+        if attempt is None or not attempt.probe:
+            return
+        with self._mtx:
+            self._probe_inflight = False
+
     def record_failure(
         self, exc: BaseException, attempt: Optional[Attempt] = None
     ) -> str:

@@ -429,11 +429,32 @@ def active_impl(backend: Optional[str] = None) -> str:
     return "pallas" if _platform(backend) in ("tpu", "axon") else "xla"
 
 
-def _run_chunk(inputs: dict, backend: Optional[str]):
-    """Dispatch one padded legacy chunk, preferring Pallas on TPU."""
+def _run_chunk(inputs: dict, backend: Optional[str], plan=None):
+    """Dispatch one padded legacy chunk, preferring Pallas on TPU.
+
+    Returns ``(result, plan_used)``: ``plan_used`` is the (possibly
+    degraded) mesh plan when the chunk went out lane-sharded, else
+    None. With a plan, a mesh that loses all usable devices falls
+    through to the single-device dispatch below — never to host."""
     global _PALLAS_BROKEN
     from tendermint_tpu.ops import fault_injection
 
+    # TENDERMINT_TPU_VERIFY_IMPL=mxu forces the int8 contraction; the
+    # field-level default (field32.set_mul_impl / TENDERMINT_TPU_FIELD_MUL)
+    # is honored otherwise.
+    impl = active_impl(backend)
+    mul_impl = "mxu" if impl == "mxu" else field.get_mul_impl()
+    if plan is not None:
+        from tendermint_tpu.parallel import sharding as mesh_sharding
+
+        try:
+            return mesh_sharding.run_chunk_mesh(
+                "ed25519", inputs, mul_impl, plan, "ed25519.chunk"
+            )
+        except mesh_sharding.MeshUnavailableError:
+            # Every device excluded: degrade to THIS backend's single-
+            # device dispatch below; host fallback stays with the caller.
+            pass
     fault_injection.fire("ed25519.chunk")
     args = (
         jnp.asarray(inputs["pk"]),
@@ -442,12 +463,11 @@ def _run_chunk(inputs: dict, backend: Optional[str]):
         jnp.asarray(inputs["k"]),
     )
     m = inputs["pk"].shape[0]
-    impl = active_impl(backend)
     if impl == "pallas":
         try:
             from tendermint_tpu.ops import pallas_verify
 
-            return pallas_verify.compiled_verify(m)(*args)
+            return pallas_verify.compiled_verify(m)(*args), None
         except Exception as exc:  # compile/runtime failure -> XLA graph
             _PALLAS_BROKEN = True
             import warnings
@@ -455,18 +475,27 @@ def _run_chunk(inputs: dict, backend: Optional[str]):
             warnings.warn(
                 f"pallas verifier failed ({exc!r}); falling back to XLA graph"
             )
-    # TENDERMINT_TPU_VERIFY_IMPL=mxu forces the int8 contraction; the
-    # field-level default (field32.set_mul_impl / TENDERMINT_TPU_FIELD_MUL)
-    # is honored otherwise.
-    mul_impl = "mxu" if impl == "mxu" else field.get_mul_impl()
-    return _compiled_kernel(m, backend, mul_impl)(*args)
+    return _compiled_kernel(m, backend, mul_impl)(*args), None
 
 
-def _run_chunk_tables(inputs: dict, backend: Optional[str]):
-    """Dispatch one padded cache-hit chunk through the table kernel."""
+def _run_chunk_tables(inputs: dict, backend: Optional[str], plan=None):
+    """Dispatch one padded cache-hit chunk through the table kernel.
+    Same ``(result, plan_used)`` contract as :func:`_run_chunk`."""
     global _PALLAS_BROKEN
     from tendermint_tpu.ops import fault_injection
 
+    impl = active_impl(backend)
+    mul_impl = "mxu" if impl == "mxu" else field.get_mul_impl()
+    if plan is not None:
+        from tendermint_tpu.parallel import sharding as mesh_sharding
+
+        try:
+            return mesh_sharding.run_chunk_mesh(
+                "tables", inputs, mul_impl, plan, "ed25519.chunk"
+            )
+        except mesh_sharding.MeshUnavailableError:
+            # Every device excluded: single-device path, not host.
+            pass
     fault_injection.fire("ed25519.chunk")
     args = (
         jnp.asarray(inputs["tab"]),
@@ -476,12 +505,11 @@ def _run_chunk_tables(inputs: dict, backend: Optional[str]):
         jnp.asarray(inputs["k"]),
     )
     m = inputs["r"].shape[0]
-    impl = active_impl(backend)
     if impl == "pallas":
         try:
             from tendermint_tpu.ops import pallas_verify
 
-            return pallas_verify.compiled_verify_tables(m)(*args)
+            return pallas_verify.compiled_verify_tables(m)(*args), None
         except Exception as exc:  # compile/runtime failure -> XLA graph
             _PALLAS_BROKEN = True
             import warnings
@@ -489,8 +517,7 @@ def _run_chunk_tables(inputs: dict, backend: Optional[str]):
             warnings.warn(
                 f"pallas table verifier failed ({exc!r}); falling back to XLA graph"
             )
-    mul_impl = "mxu" if impl == "mxu" else field.get_mul_impl()
-    return _compiled_kernel_tables(m, backend, mul_impl)(*args)
+    return _compiled_kernel_tables(m, backend, mul_impl)(*args), None
 
 
 # --- host-side preparation --------------------------------------------------
@@ -503,6 +530,44 @@ def _bucket(n: int) -> int:
         if n <= b:
             return b
     return ((n + CHUNK - 1) // CHUNK) * CHUNK
+
+
+def _mesh_bucket(n: int, n_dev: int) -> int:
+    """Padded size for n lanes sharded over n_dev devices: the
+    per-device slab stays in the bucket table so the sharded compile
+    cache hits (512 lanes on 8 devices -> 64-lane slabs -> 512)."""
+    return _bucket(max(1, -(-n // n_dev))) * n_dev
+
+
+def _mesh_plan(lanes: int):
+    """A mesh plan (parallel/mesh.MeshPlan) when the sharded path
+    should serve this batch, else None. Any trouble building one —
+    parallel package unavailable, no backend — means 'unsharded',
+    never a verification error."""
+    try:
+        from tendermint_tpu.parallel import mesh as mesh_mod
+
+        return mesh_mod.plan_for_lanes(lanes)
+    except Exception:  # sharding is an optimization; never block verify
+        return None
+
+
+def _mesh_on_success(plan) -> None:
+    try:
+        from tendermint_tpu.parallel import mesh as mesh_mod
+
+        mesh_mod.manager.on_success(plan)
+    except Exception:  # health bookkeeping must never fail verification
+        pass
+
+
+def _mesh_abandon(plan) -> None:
+    try:
+        from tendermint_tpu.parallel import mesh as mesh_mod
+
+        mesh_mod.manager.abandon(plan)
+    except Exception:  # health bookkeeping must never fail verification
+        pass
 
 
 # A known-good padding triple so padded lanes verify true and never mask
@@ -702,17 +767,57 @@ class _Job:
     device) or cache-hit (gathered table input). ``rows`` are original
     batch indices; the padded tail is sliced off at scatter time."""
 
-    __slots__ = ("kind", "rows", "prepped", "out")
+    __slots__ = ("kind", "rows", "prepped", "out", "plan")
 
     def __init__(self, kind: str, rows: np.ndarray):
         self.kind = kind
         self.rows = rows
         self.prepped = None  # (inputs dict, host_ok) once prep ran
         self.out = None  # in-flight device result
+        self.plan = None  # mesh plan this chunk dispatched on (or None)
 
 
-def _chunk_rows(rows: np.ndarray) -> List[np.ndarray]:
-    return [rows[lo : lo + CHUNK] for lo in range(0, len(rows), CHUNK)]
+def _chunk_rows(rows: np.ndarray, span: int = CHUNK) -> List[np.ndarray]:
+    return [rows[lo : lo + span] for lo in range(0, len(rows), span)]
+
+
+def _mesh_collect_retry(job: "_Job", backend: Optional[str], exc: Exception):
+    """A sharded chunk died at materialization. If the failure is
+    attributable to one device, exclude it, rebuild a smaller mesh, and
+    re-dispatch THIS chunk on it — 'a sick chip degrades the mesh, not
+    to host' holds for collect-time failures too. Returns the chunk's
+    verdict array, or None so the caller keeps its ordinary host
+    fallback (unattributed failure, or the retry failed as well)."""
+    try:
+        from tendermint_tpu.parallel import mesh as mesh_mod
+        from tendermint_tpu.parallel import sharding as mesh_sharding
+
+        culprit = mesh_mod.manager.on_failure(job.plan, exc)
+        if culprit is None:
+            return None
+        nxt = mesh_mod.manager.replan(job.plan)
+        if nxt is None:
+            return None
+        import warnings
+
+        warnings.warn(
+            f"sharded chunk ({job.kind}) failed at collect ({exc!r}); "
+            f"device {culprit} excluded, retrying on a {nxt.n_dev}-device mesh"
+        )
+        inputs, _ = job.prepped
+        runner = _run_chunk_tables if job.kind == "tables" else _run_chunk
+        out, used = runner(inputs, backend, nxt)
+        ok = (
+            mesh_sharding.collect_sharded(out, "ed25519")
+            if used is not None
+            else np.asarray(out)
+        )
+        if used is not None:
+            _mesh_on_success(used)
+        job.plan = used
+        return ok
+    except Exception:  # retry is best-effort; host fallback covers the chunk
+        return None
 
 
 def verify_batch(
@@ -826,8 +931,20 @@ def _verify_uncached(
         has_table = np.zeros(n, dtype=bool)
         entries = None
 
-    jobs = [_Job("tables", rows) for rows in _chunk_rows(np.nonzero(has_table)[0])]
-    jobs += [_Job("legacy", rows) for rows in _chunk_rows(np.nonzero(~has_table)[0])]
+    # Mesh plan for this batch: when one exists, chunks span all its
+    # devices — span and padding scale by the device count so each chip
+    # still sees bucket-size slabs. A plan degraded mid-batch replaces
+    # `plan` so later chunks ride the smaller mesh.
+    plan = _mesh_plan(n)
+    span = CHUNK * plan.n_dev if plan is not None else CHUNK
+    mesh_used = False
+
+    jobs = [
+        _Job("tables", rows) for rows in _chunk_rows(np.nonzero(has_table)[0], span)
+    ]
+    jobs += [
+        _Job("legacy", rows) for rows in _chunk_rows(np.nonzero(~has_table)[0], span)
+    ]
 
     def prep_job(job: _Job) -> Tuple[dict, np.ndarray]:
         with tracing.span(
@@ -840,7 +957,11 @@ def _verify_uncached(
             pks = [pubkeys[i] for i in job.rows]
             ms = [msgs[i] for i in job.rows]
             sgs = [sigs[i] for i in job.rows]
-            pad_to = _bucket(len(job.rows))
+            pad_to = (
+                _mesh_bucket(len(job.rows), plan.n_dev)
+                if plan is not None
+                else _bucket(len(job.rows))
+            )
             if job.kind == "tables":
                 return _prep_table_chunk(
                     pks,
@@ -894,7 +1015,11 @@ def _verify_uncached(
                         kind=job.kind,
                         lanes=len(job.rows),
                     ):
-                        job.out = runner(inputs, backend)
+                        job.out, job.plan = runner(inputs, backend, plan)
+                    if job.plan is not None:
+                        mesh_used = True
+                        if job.plan is not plan:
+                            plan = job.plan  # degraded: later chunks follow
                     health.note_inflight("ed25519", len(job.rows))
                 except Exception as exc:
                     health.record_failure(exc, attempt)
@@ -913,6 +1038,11 @@ def _verify_uncached(
             except Exception as exc:
                 note_prep_failure(nxt, exc)
 
+    if plan is not None and not mesh_used:
+        # Planned but never dispatched sharded (e.g. the shared health
+        # machine denied every chunk): release probe reservations.
+        _mesh_abandon(plan)
+
     # Collect phase: JAX dispatch is async, so runtime errors can
     # surface at materialization; those too degrade per chunk.
     fallback_lanes = 0
@@ -929,18 +1059,32 @@ def _verify_uncached(
                     lanes=len(job.rows),
                 ):
                     fault_injection.fire("ed25519.collect")
-                    ok = np.asarray(job.out)
-                device_chunks_ok += 1
-            except Exception as exc:
-                health.record_failure(exc, attempt)
-                attempt = None
-                import warnings
+                    if job.plan is not None:
+                        from tendermint_tpu.parallel import (
+                            sharding as mesh_sharding,
+                        )
 
-                warnings.warn(
-                    f"device chunk ({job.kind}, {len(job.rows)} lanes) "
-                    f"failed at collect ({exc!r}); CPU fallback for the "
-                    f"chunk (device state={health.state})"
-                )
+                        ok = mesh_sharding.collect_sharded(job.out, "ed25519")
+                    else:
+                        ok = np.asarray(job.out)
+                device_chunks_ok += 1
+                if job.plan is not None:
+                    _mesh_on_success(job.plan)
+            except Exception as exc:
+                if job.plan is not None:
+                    ok = _mesh_collect_retry(job, backend, exc)
+                if ok is not None:
+                    device_chunks_ok += 1
+                else:
+                    health.record_failure(exc, attempt)
+                    attempt = None
+                    import warnings
+
+                    warnings.warn(
+                        f"device chunk ({job.kind}, {len(job.rows)} lanes) "
+                        f"failed at collect ({exc!r}); CPU fallback for the "
+                        f"chunk (device state={health.state})"
+                    )
             finally:
                 health.note_inflight("ed25519", -len(job.rows))
         if not len(job.rows):

@@ -42,11 +42,19 @@ from typing import Callable, Iterable, Optional, Set
 class DeviceFault(RuntimeError):
     """Injected device error. ``permanent`` mirrors the shape of a
     backend-init failure vs a flaky launch; device_policy classifies on
-    the attribute, so injected faults never depend on message text."""
+    the attribute, so injected faults never depend on message text.
+    ``device`` (an optional device id) mirrors a fault attributable to
+    one chip of a mesh; parallel/mesh.attribute_device reads it."""
 
-    def __init__(self, message: str = "injected device fault", permanent: bool = False):
+    def __init__(
+        self,
+        message: str = "injected device fault",
+        permanent: bool = False,
+        device: Optional[int] = None,
+    ):
         super().__init__(message)
         self.permanent = permanent
+        self.device = device
 
 
 class FaultPlan:

@@ -38,6 +38,10 @@ from tendermint_tpu.ops.ed25519_batch import (
     CHUNK,
     _bucket,
     _bytes_to_fe,
+    _mesh_abandon,
+    _mesh_bucket,
+    _mesh_on_success,
+    _mesh_plan,
     _to_windows_signed,
     canonical_lt,
     straus_sb_minus_ka,
@@ -219,7 +223,13 @@ def verify_batch_sr(
         host_ok &= (enc[:, 0] & 1) == 0
 
     try:
-        m = _bucket(n)
+        # Mesh plan: when one exists, chunk span and padding scale by
+        # the device count (same policy as ed25519's _verify_uncached);
+        # a plan degraded mid-batch replaces `plan` for later chunks.
+        plan = _mesh_plan(n)
+        span = CHUNK * plan.n_dev if plan is not None else CHUNK
+        m = _mesh_bucket(n, plan.n_dev) if plan is not None else _bucket(n)
+        mesh_used = False
         pad = _pad_entry() if m > n else None
         from tendermint_tpu.ops.ed25519_batch import active_impl
 
@@ -271,9 +281,9 @@ def verify_batch_sr(
     # failing chunk falls back to the host oracle for ITS lanes only;
     # the health machine decides whether the remaining chunks may still
     # use the device.
-    bounds = [(lo, min(lo + CHUNK, m)) for lo in range(0, m, CHUNK)]
+    bounds = [(lo, min(lo + span, m)) for lo in range(0, m, span)]
     preps: List[Optional[tuple]] = [None] * len(bounds)
-    chunks = []  # (lo, hi, device result or None)
+    chunks = []  # (lo, hi, device result or None, mesh plan or None)
     for ci, (lo, hi) in enumerate(bounds):
         if ci == 0:
             try:
@@ -288,6 +298,7 @@ def verify_batch_sr(
                     f"CPU fallback for the chunk (device state={health.state})"
                 )
         out = None
+        chunk_plan = None
         if preps[ci] is not None:
             if attempt is None:
                 attempt = health.begin_attempt("sr25519")
@@ -299,10 +310,33 @@ def verify_batch_sr(
                         engine="sr25519",
                         lanes=hi - lo,
                     ):
-                        fault_injection.fire("sr25519.chunk")
-                        out = _compiled_kernel_sr(hi - lo, backend, mul_impl)(
-                            *(jnp.asarray(a) for a in preps[ci])
-                        )
+                        if plan is not None:
+                            from tendermint_tpu.parallel import (
+                                sharding as mesh_sharding,
+                            )
+
+                            pk_c, r_c, s_c, k_c = preps[ci]
+                            try:
+                                out, chunk_plan = mesh_sharding.run_chunk_mesh(
+                                    "sr25519",
+                                    dict(pk=pk_c, r=r_c, s=s_c, k=k_c),
+                                    mul_impl,
+                                    plan,
+                                    "sr25519.chunk",
+                                )
+                                mesh_used = True
+                                if chunk_plan is not plan:
+                                    plan = chunk_plan  # degraded: later
+                                    # chunks ride the smaller mesh
+                            except mesh_sharding.MeshUnavailableError:
+                                # Every device excluded: single-device
+                                # dispatch below, not host fallback.
+                                plan = None
+                        if out is None:
+                            fault_injection.fire("sr25519.chunk")
+                            out = _compiled_kernel_sr(
+                                len(preps[ci][0]), backend, mul_impl
+                            )(*(jnp.asarray(a) for a in preps[ci]))
                     health.note_inflight("sr25519", hi - lo)
                 except Exception as exc:
                     health.record_failure(exc, attempt)
@@ -315,7 +349,7 @@ def verify_batch_sr(
                         f"(device state={health.state})"
                     )
         preps[ci] = None  # free the buffers once dispatched
-        chunks.append((lo, hi, out))
+        chunks.append((lo, hi, out, chunk_plan))
         if ci + 1 < len(bounds):
             nlo, nhi = bounds[ci + 1]
             try:
@@ -330,11 +364,15 @@ def verify_batch_sr(
                     f"CPU fallback for the chunk (device state={health.state})"
                 )
 
+    if plan is not None and not mesh_used:
+        # Planned but never dispatched sharded: release probe slots.
+        _mesh_abandon(plan)
+
     # Collect phase: async dispatch surfaces runtime errors here too.
     results = np.ones(m, dtype=bool)
     fallback_lanes = 0
     device_chunks_ok = 0
-    for lo, hi, out in chunks:
+    for lo, hi, out, chunk_plan in chunks:
         ok = None
         if out is not None:
             try:
@@ -344,11 +382,38 @@ def verify_batch_sr(
                     engine="sr25519",
                     lanes=hi - lo,
                 ):
-                    ok = np.asarray(out)
+                    if chunk_plan is not None:
+                        from tendermint_tpu.parallel import (
+                            sharding as mesh_sharding,
+                        )
+
+                        # Sharded re-pad may exceed hi - lo (e.g. a
+                        # degraded 7-way mesh); pad lanes verify true.
+                        ok = mesh_sharding.collect_sharded(out, "sr25519")[
+                            : hi - lo
+                        ]
+                    else:
+                        ok = np.asarray(out)
                 device_chunks_ok += 1
+                if chunk_plan is not None:
+                    _mesh_on_success(chunk_plan)
             except Exception as exc:
-                health.record_failure(exc, attempt)
-                attempt = None
+                culprit = None
+                if chunk_plan is not None:
+                    try:
+                        from tendermint_tpu.parallel import mesh as mesh_mod
+
+                        culprit = mesh_mod.manager.on_failure(chunk_plan, exc)
+                    except Exception:  # attribution is best-effort
+                        culprit = None
+                if culprit is None:
+                    # Unattributed: punish the shared machine as before.
+                    # (Attributed failures cooled the culprit device
+                    # only; the chunk still host-falls-back here — its
+                    # prep buffers were freed at dispatch, so there is
+                    # nothing left to re-dispatch, unlike ed25519.)
+                    health.record_failure(exc, attempt)
+                    attempt = None
                 import warnings
 
                 warnings.warn(

@@ -1,9 +1,36 @@
-"""Multi-chip parallelism: device meshes and sharded batch verification."""
+"""Multi-chip parallelism: the mesh-native verify engine.
 
-from tendermint_tpu.parallel.sharding import (
-    make_mesh,
-    sharded_verify_fn,
-    verify_batch_sharded,
+Two halves:
+
+- :mod:`tendermint_tpu.parallel.mesh` — policy (imported eagerly; no
+  jax until a plan is requested): mesh sizing via ``TENDERMINT_TPU_MESH``
+  / ``[ops] mesh_devices``, per-device health with COOLDOWN
+  re-admission, the process-wide :data:`~mesh.manager`.
+- :mod:`tendermint_tpu.parallel.sharding` — mechanism (imported lazily;
+  pulls jax): sharded kernels for both engines + the table path,
+  chunk dispatch with degradation, per-device collect.
+"""
+
+from tendermint_tpu.parallel import mesh
+
+_LAZY = (
+    "SIG_AXIS",
+    "make_mesh",
+    "sharded_verify_fn",
+    "verify_batch_sharded",
+    "verify_batch_sharded_sr",
+    "sharding",
 )
 
-__all__ = ["make_mesh", "sharded_verify_fn", "verify_batch_sharded"]
+__all__ = ["mesh", *_LAZY]
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        sharding = importlib.import_module("tendermint_tpu.parallel.sharding")
+        if name == "sharding":
+            return sharding
+        return getattr(sharding, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,416 @@
+"""Mesh lifecycle and per-device health for the sharded verify engine.
+
+The policy half of ``parallel/``: :mod:`tendermint_tpu.parallel.sharding`
+compiles and dispatches lane-sharded kernels; this module decides *which
+devices* each dispatch may span and settles the health consequences.
+
+One process-wide :class:`MeshManager` (``manager``) owns:
+
+- **Discovery + sizing** — the mesh defaults to every device; the
+  ``[ops] mesh_devices`` config (``configure()``) caps it, and the
+  ``TENDERMINT_TPU_MESH`` env var applies when the config is unset
+  (the same precedence pattern as ``verify_remote`` in verifyd/client).
+  A resolved size below 2 disables sharding: the engines keep their
+  single-device path.
+- **Per-device health** — one :class:`~ops.device_policy.DeviceHealth`
+  machine per device id with ``retry_budget=1``: the first failure
+  *attributed* to a device (``DeviceFault.device`` or a ``device N``
+  mention in the error text) puts that device in COOLDOWN and every
+  later :meth:`plan` builds a smaller mesh around it. A sick chip
+  degrades the mesh to (n-1)-way — it never forces the host fallback;
+  that remains the job of the *shared* machine in ops/device_policy.
+- **COOLDOWN re-admission** — once an excluded device's backoff
+  expires, the next plan admits it as that machine's half-open probe:
+  a successful sharded dispatch re-promotes it (``readmissions``),
+  a failure re-arms the cooldown with doubled backoff.
+- **Forced meshes** — ``verify_batch_sharded(..., mesh=...)`` scopes an
+  explicit mesh via the :meth:`forced` context manager; plans built
+  inside use exactly those devices (minus health-excluded ones) and
+  skip the lane floor.
+
+Everything here is control-plane: no jax import until a plan is
+actually requested, so config plumbing (node assembly, verifyd CLI)
+stays cheap.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tendermint_tpu.libs import tracing
+
+
+def _dp():
+    # Lazy: tendermint_tpu.ops eagerly imports the ed25519 engine (and
+    # with it jax), and this module is imported from light config-
+    # plumbing paths (node assembly, verifyd CLI) that must stay cheap.
+    from tendermint_tpu.ops import device_policy
+
+    return device_policy
+
+
+SIG_AXIS = "sig"
+
+MESH_ENV = "TENDERMINT_TPU_MESH"
+
+# Lane floor for implicit sharding: below 4 x the smallest padding
+# bucket (ops/ed25519_batch._BUCKETS[0] == 64) the 8-way padding and
+# dispatch overhead beat the parallelism, so small batches stay on the
+# single-device path (regression-pinned in tests/test_mesh.py).
+MIN_MESH_LANES = 256
+
+# "device 3" / "chip 3" / "TPU_3"-shaped mentions in error text; only
+# ids actually in the failing plan are accepted as culprits.
+_DEVICE_RE = re.compile(r"(?:device|chip|tpu)[\s_:#]*(\d+)", re.IGNORECASE)
+
+
+def attribute_device(
+    exc: BaseException, device_ids: Tuple[int, ...]
+) -> Optional[int]:
+    """Best-effort culprit attribution for a failed sharded dispatch.
+
+    An explicit integer ``device`` attribute wins (the fault-injection
+    harness and any future backend shim set it); otherwise a 'device N'
+    mention in the error text. Anything else — including ids not in the
+    plan — is None: unattributed failures take the engines' ordinary
+    per-chunk fallback instead of shrinking the mesh blindly.
+    """
+    dev = getattr(exc, "device", None)
+    if isinstance(dev, bool):
+        dev = None
+    if isinstance(dev, int):
+        return dev if dev in device_ids else None
+    m = _DEVICE_RE.search(str(exc))
+    if m:
+        parsed = int(m.group(1))
+        if parsed in device_ids:
+            return parsed
+    return None
+
+
+class MeshPlan:
+    """One batch's sharding decision: the mesh to dispatch on plus the
+    per-device health attempt tokens to settle at collect time."""
+
+    __slots__ = ("mesh", "device_ids", "attempts", "forced", "readmitted")
+
+    def __init__(self, mesh, device_ids, attempts, forced):
+        self.mesh = mesh
+        self.device_ids: Tuple[int, ...] = device_ids
+        self.attempts: Dict[int, device_policy.Attempt] = attempts
+        self.forced = forced
+        # probe devices already counted as re-admitted (on_success runs
+        # once per chunk; the same plan serves many chunks)
+        self.readmitted: set = set()
+
+    @property
+    def n_dev(self) -> int:
+        return len(self.device_ids)
+
+
+def _dev_id(device) -> int:
+    return int(getattr(device, "id", 0))
+
+
+class MeshManager:
+    """Process-wide mesh sizing + per-device health (module docstring)."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        cooldown_base: float = 5.0,
+        cooldown_max: float = 300.0,
+    ):
+        self._mtx = threading.Lock()
+        self._clock = clock
+        self.cooldown_base = cooldown_base
+        self.cooldown_max = cooldown_max
+        self._configured = 0  # [ops] mesh_devices cap; 0 = unset  # guarded-by: _mtx
+        self._devices: Optional[tuple] = None  # discovery cache  # guarded-by: _mtx
+        self._health: Dict[int, device_policy.DeviceHealth] = {}  # guarded-by: _mtx
+        self._meshes: Dict[Tuple[int, ...], object] = {}  # Mesh per id-set  # guarded-by: _mtx
+        self._metrics = None  # OpsMetrics, bound by the node  # guarded-by: _mtx
+        # observability (monotone; tests read these via snapshot())
+        self.exclusions = 0  # guarded-by: _mtx
+        self.readmissions = 0  # guarded-by: _mtx
+        self.dispatches = 0  # guarded-by: _mtx
+        self._tls = threading.local()  # forced-mesh scope, per thread
+
+    # --- wiring --------------------------------------------------------------
+
+    def configure(self, n_devices: int) -> None:
+        """Apply the ``[ops] mesh_devices`` cap (0 = all devices; the
+        TENDERMINT_TPU_MESH env var applies only when this is 0)."""
+        with self._mtx:
+            self._configured = max(0, int(n_devices or 0))
+
+    def bind_metrics(self, metrics) -> None:
+        """Mirror mesh activity into a libs/metrics.OpsMetrics. Last
+        binder wins (one node per process outside tests)."""
+        with self._mtx:
+            self._metrics = metrics
+        if metrics is not None:
+            metrics.mesh_devices.set(0)
+
+    def reset(self) -> None:
+        """Tests/operator: drop all per-device state and overrides."""
+        with self._mtx:
+            self._configured = 0
+            self._devices = None
+            self._health.clear()
+            self.exclusions = 0
+            self.readmissions = 0
+            self.dispatches = 0
+
+    # --- forced-mesh scope ----------------------------------------------------
+
+    @contextmanager
+    def forced(self, mesh):
+        """Scope an explicit mesh (verify_batch_sharded(..., mesh=...)):
+        plans built inside dispatch on exactly these devices, minus any
+        health-excluded ones, regardless of the configured cap."""
+        prev = getattr(self._tls, "mesh", None)
+        self._tls.mesh = mesh
+        try:
+            yield
+        finally:
+            self._tls.mesh = prev
+
+    def forced_mesh(self):
+        return getattr(self._tls, "mesh", None)
+
+    # --- sizing ---------------------------------------------------------------
+
+    def _discover_locked(self) -> list:
+        if self._devices is None:
+            try:
+                import jax
+
+                self._devices = tuple(jax.devices())
+            except Exception:  # no backend: sharding simply unavailable
+                self._devices = ()
+        return list(self._devices)
+
+    def _limit_locked(self, n_available: int) -> int:
+        limit = self._configured
+        if limit <= 0:
+            env = os.environ.get(MESH_ENV, "").strip().lower()
+            if env in ("off", "none", "host"):
+                return 1
+            if env and env not in ("all", "auto", "0"):
+                try:
+                    limit = int(env)
+                except ValueError:
+                    limit = 0
+        if limit <= 0:
+            limit = n_available
+        return min(limit, n_available)
+
+    def device_count(self) -> int:
+        """Devices a non-forced plan would span right now (config/env
+        capped); 1 when sharding is unavailable. Never raises — the
+        scheduler uses this to size cross-client super-batches."""
+        try:
+            with self._mtx:
+                devs = self._discover_locked()
+                if len(devs) < 2:
+                    return 1
+                return max(1, self._limit_locked(len(devs)))
+        except Exception:  # discovery is best-effort from light callers
+            return 1
+
+    def _health_locked(self, did: int) -> device_policy.DeviceHealth:
+        h = self._health.get(did)
+        if h is None:
+            # retry_budget=1: ONE attributed failure excludes the chip —
+            # retrying a chunk on a mesh containing a known-sick device
+            # would just fail again and double the lost latency.
+            h = _dp().DeviceHealth(
+                retry_budget=1,
+                cooldown_base=self.cooldown_base,
+                cooldown_max=self.cooldown_max,
+                clock=self._clock,
+            )
+            self._health[did] = h
+        return h
+
+    # --- planning -------------------------------------------------------------
+
+    def plan(self) -> Optional[MeshPlan]:
+        """The device set for one batch, or None for the single-device
+        path. COOLDOWN devices whose backoff expired join as half-open
+        probes; their attempt outcome is settled by on_success /
+        on_failure (or released by abandon)."""
+        forced = self.forced_mesh()
+        with self._mtx:
+            if forced is not None:
+                devs = list(forced.devices.flat)
+            else:
+                devs = self._discover_locked()
+                if not devs:
+                    return None
+                limit = self._limit_locked(len(devs))
+                if limit < 2:
+                    return None
+                devs = devs[:limit]
+            health = {_dev_id(d): self._health_locked(_dev_id(d)) for d in devs}
+        usable: List = []
+        attempts: Dict[int, device_policy.Attempt] = {}
+        for d in devs:
+            did = _dev_id(d)
+            att = health[did].begin_attempt("mesh")
+            if att is None:
+                continue
+            usable.append(d)
+            attempts[did] = att
+        min_dev = 1 if forced is not None else 2
+        if len(usable) < min_dev:
+            for did, att in attempts.items():
+                health[did].release_probe(att)
+            return None
+        ids = tuple(_dev_id(d) for d in usable)
+        if forced is not None and len(usable) == len(devs):
+            return MeshPlan(forced, ids, attempts, True)
+        return MeshPlan(self._mesh_for(ids, usable), ids, attempts, forced is not None)
+
+    def replan(self, plan: MeshPlan) -> Optional[MeshPlan]:
+        """A fresh, smaller plan after on_failure excluded a device.
+        None when no usable mesh remains — the caller degrades to the
+        single-device path (NOT the host)."""
+        return self.plan()
+
+    def _mesh_for(self, ids: Tuple[int, ...], devices: list):
+        with self._mtx:
+            mesh = self._meshes.get(ids)
+            if mesh is None:
+                from jax.sharding import Mesh
+
+                mesh = Mesh(np.asarray(devices), (SIG_AXIS,))
+                self._meshes[ids] = mesh
+            return mesh
+
+    # --- outcome settlement ---------------------------------------------------
+
+    def note_dispatch(self, plan: MeshPlan, lanes: int) -> None:
+        """One sharded chunk of ``lanes`` padded lanes went out across
+        ``plan``'s devices; mirror it into metrics."""
+        with self._mtx:
+            self.dispatches += 1
+            metrics = self._metrics
+        if metrics is not None:
+            metrics.mesh_devices.set(plan.n_dev)
+            metrics.mesh_dispatches.labels(devices=str(plan.n_dev)).inc()
+            per_dev = lanes // max(1, plan.n_dev)
+            for did in plan.device_ids:
+                metrics.mesh_lanes.labels(device=str(did)).inc(per_dev)
+
+    def on_success(self, plan: MeshPlan) -> None:
+        """A sharded chunk materialized: record success on every device
+        attempt (re-promoting any probing device)."""
+        with self._mtx:
+            metrics = self._metrics
+            health = {did: self._health.get(did) for did in plan.attempts}
+        newly_readmitted = []
+        for did, att in plan.attempts.items():
+            h = health.get(did)
+            if h is None:
+                continue
+            if att.probe and did not in plan.readmitted:
+                plan.readmitted.add(did)
+                newly_readmitted.append(did)
+            h.record_success(att)
+        if newly_readmitted:
+            with self._mtx:
+                self.readmissions += len(newly_readmitted)
+            for did in newly_readmitted:
+                tracing.instant("mesh_device_readmitted", device=did)
+                if metrics is not None:
+                    metrics.mesh_readmissions.labels(device=str(did)).inc()
+
+    def on_failure(self, plan: MeshPlan, exc: BaseException) -> Optional[int]:
+        """A sharded dispatch/collect failed. Returns the culprit device
+        id when the failure is attributable (that device enters its
+        COOLDOWN; the caller should replan and retry the chunk), else
+        None (the caller keeps its ordinary per-chunk fallback). Either
+        way, in-flight probe reservations are settled."""
+        culprit = attribute_device(exc, plan.device_ids)
+        with self._mtx:
+            metrics = self._metrics
+            if culprit is not None:
+                self.exclusions += 1
+            health = {did: self._health.get(did) for did in plan.attempts}
+        stall = _dp().DeviceStallError(
+            "sharded dispatch failed"
+            + (f" (device {culprit} excluded)" if culprit is not None else "")
+        )
+        for did, att in plan.attempts.items():
+            h = health.get(did)
+            if h is None:
+                continue
+            if did == culprit:
+                h.record_failure(exc, att)
+            elif att.probe and did not in plan.readmitted:
+                # The probe rode a dispatch that died: re-arm its cooldown
+                # rather than concluding anything about the device.
+                h.record_failure(stall, att)
+        if culprit is not None:
+            # Drop the culprit's token: the same plan object may serve
+            # later chunks of the batch, and a stale on_success must not
+            # re-promote a chip just sent to COOLDOWN.
+            plan.attempts.pop(culprit, None)
+            tracing.instant("mesh_device_excluded", device=culprit)
+            if metrics is not None:
+                metrics.mesh_exclusions.labels(device=str(culprit)).inc()
+        return culprit
+
+    def abandon(self, plan: MeshPlan) -> None:
+        """The engine built a plan but never dispatched on it (e.g. the
+        shared health machine denied every chunk): release un-dispatched
+        probe reservations so excluded devices stay probe-able."""
+        with self._mtx:
+            health = {did: self._health.get(did) for did in plan.attempts}
+        for did, att in plan.attempts.items():
+            h = health.get(did)
+            if h is not None and did not in plan.readmitted:
+                h.release_probe(att)
+
+    # --- inspection -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._mtx:
+            health = dict(self._health)
+            out = {
+                "configured": self._configured,
+                "exclusions": self.exclusions,
+                "readmissions": self.readmissions,
+                "dispatches": self.dispatches,
+            }
+        dp = _dp()
+        out["devices"] = {did: h.state for did, h in sorted(health.items())}
+        out["excluded"] = sorted(
+            did
+            for did, h in health.items()
+            if h.state in (dp.COOLDOWN, dp.DISABLED)
+        )
+        return out
+
+
+# The process-wide instance both engines, the scheduler, verifyd, and
+# the node share.
+manager = MeshManager()
+
+
+def plan_for_lanes(lanes: int) -> Optional[MeshPlan]:
+    """The engines' gate: a plan when the batch is worth sharding, None
+    for the single-device path. An explicit (forced) mesh skips the
+    lane floor — the caller asked for sharding."""
+    if manager.forced_mesh() is None and lanes < MIN_MESH_LANES:
+        return None
+    return manager.plan()

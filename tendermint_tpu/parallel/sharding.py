@@ -1,29 +1,52 @@
 """Sharded batch verification over a device mesh.
 
 The TPU analog of the reference's task-level concurrency inventory
-(SURVEY.md §2.4): signature lanes are the data-parallel axis. The Straus
-verification kernel (ops/ed25519_batch.py) is lane-local — no
-cross-signature communication — so sharding the lane axis over an ICI
-mesh partitions with zero collectives; XLA emits per-device slices and
-the only sync is the final per-lane bool gather.
+(SURVEY.md §2.4): signature lanes are the data-parallel axis. All three
+kernel entry points — ed25519 build-on-device (ops/ed25519_batch
+.verify_kernel), the table-input cache-hit variant
+(verify_kernel_tables, with the gathered ``(8, 4, 32, N)`` precompute
+tensor sharded ``P(None, None, None, 'sig')`` so each device holds only
+its own lanes' tables), and sr25519 (ops/sr25519_batch
+.verify_kernel_sr) — are lane-local with no cross-signature
+communication, so sharding the lane axis over an ICI mesh partitions
+with zero collectives; XLA emits per-device slices and the only sync is
+the final per-lane bool gather.
 
-For commits larger than one chip's VMEM-friendly batch (100k-validator
-commits, BASELINE.md config 5), this is the scaling path: a
-``Mesh(devices, ('sig',))`` with lanes sharded over 'sig'.
+This module is the mechanism half of the mesh engine: compile-cached
+sharded kernels, slab padding to a device multiple, the
+dispatch-with-degradation loop (:func:`run_chunk_mesh`), and per-device
+collection (:func:`collect_sharded`). Policy — which devices, per-device
+health, COOLDOWN re-admission — lives in
+:mod:`tendermint_tpu.parallel.mesh`; the engines (ops/ed25519_batch,
+ops/sr25519_batch) call in here per chunk, so scheduler and verifyd
+super-batches span devices without their callers changing at all.
+
+Failure semantics: a dispatch failure attributable to one chip excludes
+that chip and retries the chunk on a rebuilt smaller mesh (7-way, not
+host); only when no usable mesh remains does :class:`MeshUnavailableError`
+hand the chunk back to the engine's single-device path. Unattributed
+failures propagate to the engine's ordinary per-chunk host fallback.
 """
 
 from __future__ import annotations
 
+import warnings
 from functools import lru_cache
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tendermint_tpu.ops import ed25519_batch
+from tendermint_tpu.libs import tracing
+from tendermint_tpu.ops import ed25519_batch, field32 as field
+from tendermint_tpu.parallel import mesh as mesh_mod
+from tendermint_tpu.parallel.mesh import SIG_AXIS
 
-SIG_AXIS = "sig"
+
+class MeshUnavailableError(RuntimeError):
+    """No usable multi-device mesh remains for this chunk; the caller
+    should take its single-device path (NOT the host oracle)."""
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -38,21 +61,189 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.asarray(devices), (SIG_AXIS,))
 
 
-@lru_cache(maxsize=8)
-def _sharded_fn_for_mesh(mesh: Mesh):
-    # Kernel inputs are (N, 32) uint8 raw-byte arrays: lanes on axis 0.
+@lru_cache(maxsize=32)
+def _sharded_kernel(mesh: Mesh, kind: str, mul_impl: str):
+    """Jitted lane-sharded kernel per (mesh, entry point, field-mul
+    impl). The mul impl is a trace-time switch on field32, pinned inside
+    the traced fn (same rules as ops/ed25519_batch._compiled_kernel) and
+    therefore part of the cache key."""
     rows = NamedSharding(mesh, P(SIG_AXIS, None))
-    lane1 = NamedSharding(mesh, P(SIG_AXIS))
-    return jax.jit(
-        ed25519_batch.verify_kernel,
-        in_shardings=(rows, rows, rows, rows),
-        out_shardings=lane1,
-    )
+    lane = NamedSharding(mesh, P(SIG_AXIS))
+    if kind == "tables":
+        # (8, 4, 32, N): lanes on the LAST axis — each device gathers
+        # and holds only its own lanes' precompute tables.
+        tab = NamedSharding(mesh, P(None, None, None, SIG_AXIS))
+
+        def run_tables(t, ok, r, s, k):
+            with field.pinned_mul_impl(mul_impl):
+                return ed25519_batch.verify_kernel_tables(t, ok, r, s, k)
+
+        return jax.jit(
+            run_tables,
+            in_shardings=(tab, lane, rows, rows, rows),
+            out_shardings=lane,
+        )
+    if kind == "sr25519":
+        from tendermint_tpu.ops import sr25519_batch
+
+        def run(pk, r, s, k):
+            with field.pinned_mul_impl(mul_impl):
+                return sr25519_batch.verify_kernel_sr(pk, r, s, k)
+
+    else:
+
+        def run(pk, r, s, k):
+            with field.pinned_mul_impl(mul_impl):
+                return ed25519_batch.verify_kernel(pk, r, s, k)
+
+    return jax.jit(run, in_shardings=(rows,) * 4, out_shardings=lane)
 
 
 def sharded_verify_fn(mesh: Mesh):
-    """Jitted verify kernel with lane-axis sharding over ``mesh``."""
-    return _sharded_fn_for_mesh(mesh)
+    """Jitted ed25519 verify kernel with lane-axis sharding over
+    ``mesh`` (back-compat entry point; see :func:`_sharded_kernel`)."""
+    return _sharded_kernel(mesh, "ed25519", field.get_mul_impl())
+
+
+# --- slab padding -------------------------------------------------------------
+
+
+def _pad_for_mesh(kind: str, inputs: dict, n_dev: int) -> Tuple[dict, int]:
+    """Pad a prepped chunk to a multiple of ``n_dev`` lanes so every
+    device gets an identical slab. The engines already pad to
+    ``_mesh_bucket`` multiples for the planned mesh; this re-pad covers
+    dispatch on a DEGRADED mesh (8-way prep retried 7-way: 512 -> 518).
+    Pad lanes verify true and are sliced off at collect."""
+    m = int(inputs["r"].shape[0])
+    target = -(-m // n_dev) * n_dev
+    if target == m:
+        return inputs, m
+    extra = target - m
+    out = dict(inputs)
+    if kind == "sr25519":
+        from tendermint_tpu.ops import sr25519_batch
+
+        for key, row in zip(("pk", "r", "s", "k"), sr25519_batch._pad_entry()):
+            out[key] = np.concatenate(
+                [np.asarray(inputs[key]), np.tile(row.reshape(1, 32), (extra, 1))]
+            )
+        return out, target
+    if kind == "tables":
+        pad_tab = ed25519_batch._pad_table()  # (8, 4, 32) uint8
+        out["tab"] = np.concatenate(
+            [
+                np.asarray(inputs["tab"]),
+                np.broadcast_to(pad_tab[..., None], pad_tab.shape + (extra,)),
+            ],
+            axis=3,
+        )
+        ok = np.asarray(inputs["ok"])
+        out["ok"] = np.concatenate([ok, np.ones(extra, dtype=ok.dtype)])
+        keys = ("r", "s", "k")
+        pad_rows = ed25519_batch._pad_rows()[1:]
+    else:
+        keys = ("pk", "r", "s", "k")
+        pad_rows = ed25519_batch._pad_rows()
+    for key, row in zip(keys, pad_rows):
+        out[key] = np.concatenate([np.asarray(inputs[key]), np.tile(row, (extra, 1))])
+    return out, target
+
+
+def _kernel_args(kind: str, inputs: dict) -> tuple:
+    if kind == "tables":
+        return (inputs["tab"], inputs["ok"], inputs["r"], inputs["s"], inputs["k"])
+    return (inputs["pk"], inputs["r"], inputs["s"], inputs["k"])
+
+
+# --- dispatch / collect -------------------------------------------------------
+
+
+def run_chunk_mesh(
+    kind: str,
+    inputs: dict,
+    mul_impl: str,
+    plan: "mesh_mod.MeshPlan",
+    fault_site: str,
+):
+    """Dispatch one prepped chunk lane-sharded across ``plan``'s mesh.
+
+    Returns ``(device_result, plan_used)`` — ``plan_used`` may be a
+    smaller rebuilt plan if a device was excluded mid-dispatch. A
+    failure attributable to one chip excludes it (its DeviceHealth
+    enters COOLDOWN), rebuilds an (n-1)-device mesh, and retries the
+    chunk there: a sick chip degrades the mesh, never to host. Raises
+    :class:`MeshUnavailableError` when no multi-device mesh remains,
+    and re-raises unattributed failures for the engine's ordinary
+    per-chunk handling.
+    """
+    from tendermint_tpu.ops import fault_injection
+
+    mgr = mesh_mod.manager
+    engine = "sr25519" if kind == "sr25519" else "ed25519"
+    while True:
+        padded, m = _pad_for_mesh(kind, inputs, plan.n_dev)
+        fn = _sharded_kernel(plan.mesh, kind, mul_impl)
+        try:
+            with tracing.span(
+                "mesh_dispatch",
+                stage="mesh_dispatch",
+                engine=engine,
+                kind=kind,
+                devices=plan.n_dev,
+                lanes=m,
+            ):
+                fault_injection.fire(fault_site)
+                out = fn(*_kernel_args(kind, padded))
+        except Exception as exc:
+            culprit = mgr.on_failure(plan, exc)
+            if culprit is None:
+                raise
+            nxt = mgr.replan(plan)
+            if nxt is None:
+                raise MeshUnavailableError(
+                    f"device {culprit} excluded and no usable mesh remains"
+                ) from exc
+            warnings.warn(
+                f"sharded {kind} chunk failed on device {culprit} ({exc!r}); "
+                f"retrying on a {nxt.n_dev}-device mesh"
+            )
+            plan = nxt
+            continue
+        mgr.note_dispatch(plan, m)
+        per_dev = m // plan.n_dev
+        for did in plan.device_ids:
+            tracing.instant(
+                "mesh_device_dispatch", device=did, engine=engine, lanes=per_dev
+            )
+        return out, plan
+
+
+def collect_sharded(out, engine: str) -> np.ndarray:
+    """Materialize a sharded lane result device by device, one
+    ``collect_device`` span per shard so per-device D2H time lands in
+    the trace ring. Shards are stitched in lane order."""
+    shards = getattr(out, "addressable_shards", None)
+    if not shards or len(shards) <= 1:
+        return np.asarray(out)
+
+    def lane_start(sh) -> int:
+        idx = sh.index[0] if sh.index else slice(None)
+        return idx.start or 0
+
+    parts = []
+    for sh in sorted(shards, key=lane_start):
+        with tracing.span(
+            "collect_device",
+            stage="collect_device",
+            engine=engine,
+            device=str(getattr(sh.device, "id", "?")),
+            lanes=int(sh.data.shape[0]),
+        ):
+            parts.append(np.asarray(sh.data))
+    return np.concatenate(parts)
+
+
+# --- whole-batch entry points -------------------------------------------------
 
 
 def verify_batch_sharded(
@@ -60,25 +251,45 @@ def verify_batch_sharded(
     msgs: Sequence[bytes],
     sigs: Sequence[bytes],
     mesh: Optional[Mesh] = None,
+    min_lanes: Optional[int] = None,
 ) -> List[bool]:
-    """Like ops.verify_batch but sharded across every device in ``mesh``.
+    """Like ops.verify_batch but lane-sharded across ``mesh``.
 
-    Lanes are padded to a multiple of the mesh size times the bucket
-    granularity so each device gets an identical slab.
+    Routes through the full ops pipeline — digest-keyed result cache,
+    OpsMetrics, chunking, per-chunk fallback — with the mesh forced for
+    the call's scope, so sharded verification is observable exactly
+    like single-device verification. Batches below ``min_lanes``
+    (default :data:`mesh.MIN_MESH_LANES`) take the single-device path:
+    tiny batches lose more to ``n_dev``-way padding and dispatch fan-out
+    than they gain (pass ``min_lanes=0`` to force sharding, e.g. for
+    parity tests and warmup). With ``mesh=None`` the engines plan
+    against the configured mesh themselves.
     """
     n = len(pubkeys)
     if n == 0:
         return []
-    if mesh is None:
-        mesh = make_mesh()
-    n_dev = mesh.devices.size
-    per_dev = max(8, -(-n // n_dev))  # ceil, min 8 lanes per device
-    # Round per-device lanes up to the bucket table so compile cache hits.
-    per_dev = ed25519_batch._bucket(per_dev)
-    pad_to = per_dev * n_dev
-    inputs, host_ok = ed25519_batch.prepare_batch(pubkeys, msgs, sigs, pad_to=pad_to)
-    fn = _sharded_fn_for_mesh(mesh)
-    device_ok = np.asarray(
-        fn(inputs["pk"], inputs["r"], inputs["s"], inputs["k"])
-    )[:n]
-    return list(np.logical_and(device_ok, host_ok))
+    floor = mesh_mod.MIN_MESH_LANES if min_lanes is None else min_lanes
+    if mesh is None or n < floor:
+        return ed25519_batch.verify_batch(pubkeys, msgs, sigs)
+    with mesh_mod.manager.forced(mesh):
+        return ed25519_batch.verify_batch(pubkeys, msgs, sigs)
+
+
+def verify_batch_sharded_sr(
+    pubkeys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    mesh: Optional[Mesh] = None,
+    min_lanes: Optional[int] = None,
+) -> List[bool]:
+    """sr25519 counterpart of :func:`verify_batch_sharded`."""
+    from tendermint_tpu.ops import sr25519_batch
+
+    n = len(pubkeys)
+    if n == 0:
+        return []
+    floor = mesh_mod.MIN_MESH_LANES if min_lanes is None else min_lanes
+    if mesh is None or n < floor:
+        return sr25519_batch.verify_batch_sr(pubkeys, msgs, sigs)
+    with mesh_mod.manager.forced(mesh):
+        return sr25519_batch.verify_batch_sr(pubkeys, msgs, sigs)

@@ -33,6 +33,7 @@ from tendermint_tpu.crypto import batch as crypto_batch
 from tendermint_tpu.crypto.scheduler import (
     SchedulerSaturatedError,
     VerifyScheduler,
+    default_max_batch,
 )
 from tendermint_tpu.libs import tracing
 from tendermint_tpu.libs.grpc import GrpcServer
@@ -128,7 +129,7 @@ class VerifydServer:
         self,
         host: str = "127.0.0.1",
         port: int = 0,
-        max_batch: int = 256,
+        max_batch: Optional[int] = None,
         max_delay: float = 0.002,
         admission_cap: int = DEFAULT_ADMISSION_CAP,
         max_pending: int = DEFAULT_MAX_PENDING,
@@ -150,8 +151,12 @@ class VerifydServer:
                 _host_sr25519_verify,
             ),
         }
+        # None = mesh-aware default (256 lanes per device the sharded
+        # engine spans) so cross-client super-batches fill every chip.
         self._sched_args = dict(
-            max_batch=max_batch, max_delay=max_delay, max_pending=max_pending
+            max_batch=default_max_batch() if max_batch is None else max_batch,
+            max_delay=max_delay,
+            max_pending=max_pending,
         )
         self._schedulers: Dict[int, VerifyScheduler] = {}  # guarded-by: _sched_mtx
         self._sched_mtx = threading.Lock()
@@ -174,6 +179,11 @@ class VerifydServer:
     @property
     def address(self) -> Tuple[str, int]:
         return self._grpc.address
+
+    @property
+    def max_batch(self) -> int:
+        """Resolved size-flush threshold (mesh-aware when defaulted)."""
+        return self._sched_args["max_batch"]
 
     @property
     def scheduler(self) -> VerifyScheduler:

@@ -54,7 +54,15 @@ def _fresh_verify_caches(monkeypatch):
     the caches opt back in with monkeypatch (tests/test_precompute.py).
     """
     from tendermint_tpu.ops import precompute
+    from tendermint_tpu.parallel import mesh
 
     monkeypatch.setenv(precompute._RESULT_ENV, "0")
     precompute.reset()
+    # Pin the sharded verify engine OFF for the general suite: with the
+    # virtual 8-mesh above, any ≥256-lane verify would otherwise shard
+    # and recompile per shape, blowing the tier-1 time budget. Mesh
+    # tests (tests/test_mesh.py) opt back in with monkeypatch.
+    monkeypatch.setenv(mesh.MESH_ENV, "1")
+    mesh.manager.reset()
     yield
+    mesh.manager.reset()

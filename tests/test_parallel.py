@@ -26,15 +26,18 @@ def triples():
 
 
 def test_all_valid(mesh, triples):
+    # min_lanes=0: these 40-lane batches sit below the small-batch
+    # bypass floor (parallel/mesh.MIN_MESH_LANES) — force sharding so
+    # the test keeps exercising the mesh path it was written for.
     pks, msgs, sigs = triples
-    assert all(verify_batch_sharded(pks, msgs, sigs, mesh))
+    assert all(verify_batch_sharded(pks, msgs, sigs, mesh, min_lanes=0))
 
 
 def test_bad_lane_isolated(mesh, triples):
     pks, msgs, sigs = (list(x) for x in triples)
     sigs[13] = bytes(64)
     sigs[37] = sigs[36]
-    oks = verify_batch_sharded(pks, msgs, sigs, mesh)
+    oks = verify_batch_sharded(pks, msgs, sigs, mesh, min_lanes=0)
     expect = [i not in (13, 37) for i in range(len(pks))]
     assert oks == expect
 
@@ -44,9 +47,9 @@ def test_matches_single_device(mesh, triples):
 
     pks, msgs, sigs = (list(x) for x in triples)
     sigs[5] = bytes(64)
-    assert verify_batch_sharded(pks, msgs, sigs, mesh) == ed25519_batch.verify_batch(
-        pks, msgs, sigs
-    )
+    assert verify_batch_sharded(
+        pks, msgs, sigs, mesh, min_lanes=0
+    ) == ed25519_batch.verify_batch(pks, msgs, sigs)
 
 
 def test_large_batch_parity_with_host(mesh):
