@@ -99,24 +99,32 @@ def run_throughput(beat) -> dict:
 
 
 def run_stages(beat) -> dict:
-    """One instrumented pass: prep / H2D / kernel / D2H wall times."""
+    """One instrumented pass: prep / H2D / kernel / D2H wall times, with
+    prep further split into challenge hashing (hash_ms — on-device when
+    ops/hash512 is active) and host packing (pack_ms), plus a two-pass
+    table-H2D probe over a pinned validator set (per-batch table upload
+    bytes; flat-at-zero on pass 2 when the resident store holds them)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from tendermint_tpu.ops import ed25519_batch
+    from tendermint_tpu.ops import ed25519_batch, precompute, resident
 
     batch = env_int("BENCH_BATCH", 8192)
+    backend = jax.default_backend()
     beat("workload batch=%d" % batch)
     rng = np.random.default_rng(1234)
     pks, msgs, sigs = make_workload(rng, batch)
 
     beat("prep")
+    st: dict = {}
     t0 = time.perf_counter()
     inputs, host_ok = ed25519_batch.prepare_batch(
-        pks, msgs, sigs, pad_to=ed25519_batch._bucket(len(pks))
+        pks, msgs, sigs, pad_to=ed25519_batch._bucket(len(pks)),
+        backend=backend, stage_times=st,
     )
     t_prep = time.perf_counter() - t0
+    t_hash = st.get("hash_ms", 0.0) / 1e3
 
     m = inputs["pk"].shape[0]
     chunk = ed25519_batch.CHUNK
@@ -167,14 +175,42 @@ def run_stages(beat) -> dict:
     _ = np.concatenate([np.asarray(o) for o in outs])
     t_d2h = time.perf_counter() - t0
 
+    # Two verify passes over a pinned validator set: pass 1 pays the
+    # table uploads, pass 2 shows the steady-state per-batch table-H2D
+    # cost (zero when the resident store serves the gathers).
+    table_lanes = min(batch, env_int("BENCH_STAGES_TABLE_LANES", 256))
+    beat("table-h2d probe lanes=%d" % table_lanes)
+    t_pks, t_msgs, t_sigs = pks[:table_lanes], msgs[:table_lanes], sigs[:table_lanes]
+    precompute.pin_pubkeys(t_pks)
+
+    def _table_bytes() -> int:
+        s = resident.stats()
+        return int(s["h2d_bytes"]) + int(s["gathered_h2d_bytes"])
+
+    b0 = _table_bytes()
+    ed25519_batch.verify_batch(t_pks, t_msgs, t_sigs)
+    b1 = _table_bytes()
+    beat("table-h2d probe pass 2")
+    ed25519_batch.verify_batch(t_pks, t_msgs, t_sigs)
+    b2 = _table_bytes()
+
     return {
         "impl": impl,
         "backend": jax.default_backend(),
         "stages_ms": {
             "prep_ms": round(t_prep * 1e3, 2),
+            "hash_ms": round(t_hash * 1e3, 2),
+            "pack_ms": round(max(t_prep - t_hash, 0.0) * 1e3, 2),
             "h2d_ms": round(t_h2d * 1e3, 2),
             "kernel_ms": round(t_kernel * 1e3, 2),
             "d2h_ms": round(t_d2h * 1e3, 2),
+        },
+        "hash_device": bool(st.get("hash_device", False)),
+        "table_h2d": {
+            "lanes": table_lanes,
+            "pass1_bytes": b1 - b0,
+            "pass2_bytes": b2 - b1,
+            "resident": resident.enabled(backend),
         },
     }
 

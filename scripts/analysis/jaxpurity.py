@@ -29,9 +29,13 @@ Reachability is a cross-module call graph over the ``ops/`` package:
 entry points are functions passed to ``jax.jit(...)`` (including the
 nested ``def run`` closures in the compiled-kernel caches), functions
 decorated ``@jax.jit``/``@partial(jax.jit, ...)``, and kernels passed
-to ``pl.pallas_call``. Calls resolve by simple name within a module
-and through ``from tendermint_tpu.ops import field32 as field``-style
-aliases across ops modules.
+to ``pl.pallas_call``. ``jax.jit(factory(...))`` — the autotuner's
+timing-kernel pattern — resolves through the factory to the closure it
+returns, so those bodies are checked too. Calls resolve by simple name
+within a module and through ``from tendermint_tpu.ops import field32
+as field``-style aliases across ops modules; impure names pulled in
+via ``from time import perf_counter``-style imports are flagged under
+their source module just like dotted calls.
 """
 
 from __future__ import annotations
@@ -55,6 +59,9 @@ _SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
 _STATIC_CALLS = {"len", "isinstance", "range", "enumerate", "zip", "getattr",
                  "hasattr", "min", "max"}
 _LOGGER_METHODS = {"debug", "info", "warn", "warning", "error"}
+# ``from <module> import name`` sources whose names are impure when
+# called bare inside a trace (TPJ001 via _impure_from_imports).
+_IMPURE_FROM_MODULES = {"time", "random", "os", "secrets"}
 
 
 def _fn_key(mod_rel: str, name: str) -> Tuple[str, str]:
@@ -84,8 +91,10 @@ class JaxPurityChecker(Checker):
             return
         fns: Dict[Tuple[str, str], _FnInfo] = {}
         aliases: Dict[str, Dict[str, str]] = {}  # mod.rel -> alias -> mod.rel
+        impure: Dict[str, Dict[str, str]] = {}  # mod.rel -> name -> origin
         for mod in ops_modules:
             aliases[mod.rel] = self._import_aliases(mod, ops_modules)
+            impure[mod.rel] = self._impure_from_imports(mod)
             for node in ast.walk(mod.tree):
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     fns.setdefault(
@@ -97,7 +106,7 @@ class JaxPurityChecker(Checker):
         for key in sorted(reachable):
             info = fns.get(key)
             if info is not None:
-                yield from self._check_fn(info)
+                yield from self._check_fn(info, impure.get(key[0], {}))
         for mod in ops_modules:
             yield from self._check_dtypes(mod)
 
@@ -123,6 +132,22 @@ class JaxPurityChecker(Checker):
                         out[alias.asname or stem] = by_stem[stem]
         return out
 
+    def _impure_from_imports(self, mod: Module) -> Dict[str, str]:
+        """Bare names that resolve to impure modules: ``from time import
+        perf_counter`` makes a later ``perf_counter()`` as much a
+        trace-time side effect as ``time.perf_counter()``."""
+        out: Dict[str, str] = {}
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.ImportFrom)
+                and node.module in _IMPURE_FROM_MODULES
+            ):
+                for alias in node.names:
+                    out[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+        return out
+
     def _entry_points(
         self,
         ops_modules: List[Module],
@@ -140,6 +165,16 @@ class JaxPurityChecker(Checker):
                                 key = _fn_key(mod.rel, arg.id)
                                 if key in fns:
                                     entries.add(key)
+                            elif isinstance(arg, ast.Call) and isinstance(
+                                arg.func, ast.Name
+                            ):
+                                # jax.jit(factory(...)): the traced body
+                                # is whatever closure the factory returns.
+                                entries.update(
+                                    self._factory_returns(
+                                        mod.rel, arg.func.id, fns
+                                    )
+                                )
                 # decorators
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     for dec in node.decorator_list:
@@ -154,6 +189,27 @@ class JaxPurityChecker(Checker):
                         ):
                             entries.add(_fn_key(mod.rel, node.name))
         return entries
+
+    def _factory_returns(
+        self,
+        mod_rel: str,
+        factory_name: str,
+        fns: Dict[Tuple[str, str], _FnInfo],
+    ) -> Set[Tuple[str, str]]:
+        """Functions a local factory returns by name — those closures
+        are the real jit entry points of ``jax.jit(factory(...))``."""
+        info = fns.get(_fn_key(mod_rel, factory_name))
+        if info is None:
+            return set()
+        out: Set[Tuple[str, str]] = set()
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Return) and isinstance(
+                node.value, ast.Name
+            ):
+                key = _fn_key(mod_rel, node.value.id)
+                if key in fns:
+                    out.add(key)
+        return out
 
     def _calls_of(
         self, info: _FnInfo, aliases: Dict[str, Dict[str, str]]
@@ -194,7 +250,9 @@ class JaxPurityChecker(Checker):
 
     # --- per-function rules --------------------------------------------------
 
-    def _check_fn(self, info: _FnInfo) -> Iterator[Finding]:
+    def _check_fn(
+        self, info: _FnInfo, impure_names: Dict[str, str]
+    ) -> Iterator[Finding]:
         mod = info.module
         node = info.node
         params = {
@@ -224,7 +282,7 @@ class JaxPurityChecker(Checker):
             if sub in nested:
                 continue  # nested defs are reached (or not) on their own
             if isinstance(sub, ast.Call):
-                reason = self._impure_call(sub)
+                reason = self._impure_call(sub, impure_names)
                 if reason:
                     yield Finding(
                         mod.rel,
@@ -259,9 +317,13 @@ class JaxPurityChecker(Checker):
                         f"'{info.qualname}' (use lax.cond/select)",
                     )
 
-    def _impure_call(self, call: ast.Call) -> Optional[str]:
+    def _impure_call(
+        self, call: ast.Call, impure_names: Dict[str, str]
+    ) -> Optional[str]:
         path = dotted_name(call.func) or ""
         head = path.split(".", 1)[0]
+        if isinstance(call.func, ast.Name) and call.func.id in impure_names:
+            return f"{impure_names[call.func.id]}() call (via from-import)"
         if head == "time" and "." in path:
             return f"{path}() call"
         if path.startswith(("random.", "np.random.", "numpy.random.")):

@@ -21,7 +21,9 @@ Rules:
   class outside a ``with`` block holding one of its locks;
 - TPL002: an annotation names a lock attribute the class never assigns;
 - TPL003: a ``guarded-by`` comment sits on a line with no ``self.X``
-  assignment (orphaned — it guards nothing);
+  assignment (orphaned — it guards nothing); module-level globals are
+  the one exception, accepted when the annotation names a lock created
+  at module scope (the ops singleton-store pattern);
 - TPL004: malformed annotation text.
 
 Lock aliasing is understood one level deep: ``self._wake =
@@ -130,6 +132,7 @@ class LockDisciplineChecker(Checker):
                                 changed = True
         for info in infos.values():
             yield from self._verify(module, info)
+        self._collect_module_globals(module, annotated_lines)
         # orphaned annotations: guarded-by comments no class claimed
         for line, text in module.comments.items():
             if GUARD_RE.search(text) and line not in annotated_lines:
@@ -142,6 +145,40 @@ class LockDisciplineChecker(Checker):
                 )
 
     # --- collection ----------------------------------------------------------
+
+    def _collect_module_globals(
+        self, module: Module, annotated_lines: Set[int]
+    ) -> None:
+        """Module-level globals may carry guard annotations too (the
+        autotuner/resident-store singleton pattern): accept a
+        ``guarded-by`` comment on a top-level assignment when it names a
+        lock created at module scope (or ``none(...)``). Annotations
+        naming no such lock stay orphaned (TPL003)."""
+        module_locks: Set[str] = set()
+        assigns: List[ast.AST] = []
+        for node in module.tree.body:
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            if not any(isinstance(t, ast.Name) for t in targets):
+                continue
+            assigns.append(node)
+            if _is_lock_ctor(node.value):
+                module_locks.add(
+                    next(t.id for t in targets if isinstance(t, ast.Name))
+                )
+        for node in assigns:
+            for line in range(node.lineno, node.end_lineno + 1):
+                m = GUARD_RE.search(module.comment_on(line))
+                if not m:
+                    continue
+                spec = m.group("spec")
+                if NONE_RE.match(spec) or any(
+                    s in module_locks for s in spec.split("|") if s
+                ):
+                    annotated_lines.add(line)
 
     def _collect(
         self, module: Module, cls: ast.ClassDef, annotated_lines: Set[int]

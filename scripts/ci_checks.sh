@@ -117,6 +117,29 @@ print(
 )
 EOF
 
+echo "== kernel campaign (resident tables + device hash + autotuner) =="
+# ISSUE 8 stage: the device-resident table store, fused SHA-512
+# challenge hashing, and the field-mul autotuner forced ON on the CPU
+# backend (their auto modes keep CPU off, so tier-1 alone would never
+# execute these paths), plus the hashing parity battery. Both forced
+# TENDERMINT_TPU_FIELD_MUL values pin verify parity under each impl.
+rm -rf /tmp/_kcamp && mkdir -p /tmp/_kcamp
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    TENDERMINT_TPU_RESIDENT=on TENDERMINT_TPU_DEVICE_HASH=1 \
+    TENDERMINT_TPU_AUTOTUNE=on \
+    TENDERMINT_TPU_AUTOTUNE_CACHE=/tmp/_kcamp/autotune.json \
+    python -m pytest tests/test_resident.py tests/test_device_hash.py \
+    tests/test_autotune.py -q -p no:cacheprovider -p no:xdist \
+    -p no:randomly || rc_total=1
+for mul in vpu mxu; do
+    timeout -k 10 300 env JAX_PLATFORMS=cpu TENDERMINT_TPU_FIELD_MUL=$mul \
+        python -m pytest tests/test_ops_ed25519.py -q \
+        -p no:cacheprovider -p no:xdist -p no:randomly || {
+        echo "kernel campaign: parity failed under FIELD_MUL=$mul" >&2
+        rc_total=1
+    }
+done
+
 echo "== tier-1 pytest =="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -116,6 +116,10 @@ class OpsConfig:
     # 0 = all available devices; 1 disables sharding. The
     # TENDERMINT_TPU_MESH env var applies when this is 0.
     mesh_devices: int = 0
+    # Device-resident precompute table store (ops/resident.py):
+    # "auto" (on for tpu/axon backends), "on", or "off". Empty defers
+    # to the TENDERMINT_TPU_RESIDENT env var.
+    resident_tables: str = ""
 
 
 @dataclass
@@ -194,6 +198,7 @@ class Config:
             trace=self.base.trace,
             verify_remote=self.ops.verify_remote,
             mesh_devices=self.ops.mesh_devices,
+            resident_tables=self.ops.resident_tables,
         )
 
     # --- TOML ---------------------------------------------------------------

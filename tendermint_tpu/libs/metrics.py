@@ -446,6 +446,34 @@ class OpsMetrics(_NopMixin):
             _name(s, "result_cache_misses_total"),
             "Verifications that missed the digest-keyed result cache.",
         )
+        # Device-resident table store (ops/resident.py) and the fused
+        # kernel campaign: per-batch table shipping vs resident gather,
+        # on-device challenge hashing, autotuned field-mul selection.
+        self.table_resident_hits = reg.counter(
+            _name(s, "table_resident_hits_total"),
+            "Lanes served by the device-resident table store "
+            "(gather indices shipped, no per-batch table H2D).",
+        )
+        self.table_resident_misses = reg.counter(
+            _name(s, "table_resident_misses_total"),
+            "Cached-table lanes absent from the resident store "
+            "(shipped via the per-batch gathered path).",
+        )
+        self.table_h2d_bytes = reg.counter(
+            _name(s, "table_h2d_bytes_total"),
+            "Precompute table bytes shipped host-to-device "
+            "(resident uploads plus per-batch gathered tensors).",
+        )
+        self.hash_device_lanes = reg.counter(
+            _name(s, "hash_device_lanes_total"),
+            "Challenge scalars computed by the on-device SHA-512 kernel.",
+        )
+        self.autotune_selections = reg.counter(
+            _name(s, "autotune_selections_total"),
+            "Field-mul impl selections adopted by the autotuner, "
+            "per (platform, batch-bucket) key.",
+            labels=("impl",),
+        )
         # Mesh-sharded verify engine (parallel/mesh.py): which mesh the
         # sharded path is running on and how lanes spread across it.
         self.mesh_devices = reg.gauge(

@@ -102,6 +102,10 @@ class NodeConfig:
     # the TENDERMINT_TPU_MESH env var): 0 = all available, 1 disables
     # sharding (parallel/mesh.py).
     mesh_devices: int = 0
+    # Device-resident precompute table store ([ops] resident_tables /
+    # the TENDERMINT_TPU_RESIDENT env var): "auto" | "on" | "off",
+    # "" defers to the env var (ops/resident.py).
+    resident_tables: str = ""
 
 
 class Node:
@@ -312,6 +316,17 @@ class Node:
         from tendermint_tpu.ops import precompute as _precompute
 
         _precompute.bind_metrics(ops_metrics)
+        # Kernel-campaign units: the device-resident table store, the
+        # on-device challenge hasher, and the field-mul autotuner.
+        from tendermint_tpu.ops import autotune as _autotune
+        from tendermint_tpu.ops import hash512 as _hash512
+        from tendermint_tpu.ops import resident as _resident
+
+        if config.resident_tables:
+            _resident.configure(config.resident_tables)
+        _resident.bind_metrics(ops_metrics)
+        _hash512.bind_metrics(ops_metrics)
+        _autotune.bind_metrics(ops_metrics)
         # And the verify mesh (parallel/mesh.py): apply the configured
         # device cap and mirror sharded-dispatch activity.
         from tendermint_tpu.parallel import mesh as _mesh

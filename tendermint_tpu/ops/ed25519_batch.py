@@ -338,6 +338,29 @@ def verify_kernel_tables(
     return _finish_verify(acc, r_pt, (a_ok != 0) & r_ok)
 
 
+def verify_kernel_resident(
+    tab_store: jnp.ndarray,
+    idx: jnp.ndarray,
+    a_ok: jnp.ndarray,
+    r_bytes: jnp.ndarray,
+    s_bytes: jnp.ndarray,
+    k_bytes: jnp.ndarray,
+) -> jnp.ndarray:
+    """Device-resident entry point: tables stay on device across calls.
+
+    tab_store: (8, 4, 32, K) uint8 — the resident store's device tensor
+    (ops/resident.py), uploaded once per validator-set activation.
+    idx: (N,) int32 per-lane column indices into it. The gather runs on
+    device, so steady-state batches ship 4 bytes per lane where the
+    gathered path ships ~1 KiB. Under the mesh the store is replicated
+    and ``idx`` lane-sharded, so the take is device-local and the
+    gathered table tensor comes out lane-sharded exactly like
+    :func:`verify_kernel_tables` always saw it.
+    """
+    tab = jnp.take(tab_store, idx, axis=3)
+    return verify_kernel_tables(tab, a_ok, r_bytes, s_bytes, k_bytes)
+
+
 def _enable_persistent_cache() -> None:
     """First compilation of the verifier is expensive; persist it across
     processes (driver, tests, bench) in a repo-local cache dir."""
@@ -390,6 +413,18 @@ def _compiled_kernel_tables(n: int, backend: Optional[str], mul_impl: str = "vpu
     return jax.jit(run, backend=backend)
 
 
+@lru_cache(maxsize=16)
+def _compiled_kernel_resident(n: int, backend: Optional[str], mul_impl: str = "vpu"):
+    """Compiled resident-store verifier; jit re-traces per store width K
+    internally, the lru key pins (lane count, backend, mul impl)."""
+
+    def run(tab_store, idx, ok, r, s, k):
+        with field.pinned_mul_impl(mul_impl):
+            return verify_kernel_resident(tab_store, idx, ok, r, s, k)
+
+    return jax.jit(run, backend=backend)
+
+
 # --- implementation dispatch (XLA graph vs Pallas kernel) -------------------
 #
 # The Pallas kernel (ops/pallas_verify.py) keeps every field-op
@@ -429,6 +464,19 @@ def active_impl(backend: Optional[str] = None) -> str:
     return "pallas" if _platform(backend) in ("tpu", "axon") else "xla"
 
 
+def _mul_impl_for_chunk(impl: str, backend: Optional[str], lanes: int) -> str:
+    """Field-mul impl for one padded chunk: the explicit ``mxu`` verify
+    impl forces the contraction; otherwise the autotuner's measured
+    winner for (platform, bucket) — which degrades to the plain
+    ``field32.get_mul_impl()`` default whenever the tuner is off,
+    overridden by env, or cannot time this backend."""
+    if impl == "mxu":
+        return "mxu"
+    from tendermint_tpu.ops import autotune
+
+    return autotune.mul_impl_for(backend, lanes)
+
+
 def _run_chunk(inputs: dict, backend: Optional[str], plan=None):
     """Dispatch one padded legacy chunk, preferring Pallas on TPU.
 
@@ -440,10 +488,9 @@ def _run_chunk(inputs: dict, backend: Optional[str], plan=None):
     from tendermint_tpu.ops import fault_injection
 
     # TENDERMINT_TPU_VERIFY_IMPL=mxu forces the int8 contraction; the
-    # field-level default (field32.set_mul_impl / TENDERMINT_TPU_FIELD_MUL)
-    # is honored otherwise.
+    # autotuned (or field-level default) impl is honored otherwise.
     impl = active_impl(backend)
-    mul_impl = "mxu" if impl == "mxu" else field.get_mul_impl()
+    mul_impl = _mul_impl_for_chunk(impl, backend, inputs["pk"].shape[0])
     if plan is not None:
         from tendermint_tpu.parallel import sharding as mesh_sharding
 
@@ -485,7 +532,7 @@ def _run_chunk_tables(inputs: dict, backend: Optional[str], plan=None):
     from tendermint_tpu.ops import fault_injection
 
     impl = active_impl(backend)
-    mul_impl = "mxu" if impl == "mxu" else field.get_mul_impl()
+    mul_impl = _mul_impl_for_chunk(impl, backend, inputs["r"].shape[0])
     if plan is not None:
         from tendermint_tpu.parallel import sharding as mesh_sharding
 
@@ -518,6 +565,59 @@ def _run_chunk_tables(inputs: dict, backend: Optional[str], plan=None):
                 f"pallas table verifier failed ({exc!r}); falling back to XLA graph"
             )
     return _compiled_kernel_tables(m, backend, mul_impl)(*args), None
+
+
+def _run_chunk_resident(inputs: dict, backend: Optional[str], plan=None):
+    """Dispatch one padded resident-store chunk: only gather indices
+    ship per batch, the table tensor already lives on device. Same
+    ``(result, plan_used)`` contract as :func:`_run_chunk`.
+
+    The store tensor is committed to the context it was uploaded for
+    (one mesh, or one single device). When that context is gone —
+    mesh degraded mid-batch, or run_chunk_mesh gave up — the chunk
+    falls back to the gathered-table kernel: the store's columns are
+    pulled to host, gathered per lane, and shipped the old way (rare,
+    and still device compute).
+    """
+    from tendermint_tpu.ops import fault_injection, resident
+
+    impl = active_impl(backend)
+    mul_impl = _mul_impl_for_chunk(impl, backend, inputs["r"].shape[0])
+    mesh_ok = plan is not None and inputs.get("mesh_key") == tuple(
+        plan.device_ids
+    )
+    if mesh_ok:
+        from tendermint_tpu.parallel import sharding as mesh_sharding
+
+        try:
+            return mesh_sharding.run_chunk_mesh(
+                "resident", inputs, mul_impl, plan, "ed25519.chunk"
+            )
+        except mesh_sharding.MeshUnavailableError:
+            # The store is committed to the dead mesh; gathered-table
+            # fallback below re-ships this chunk's columns explicitly.
+            pass
+    fault_injection.fire("ed25519.chunk")
+    m = inputs["r"].shape[0]
+    if plan is None and inputs.get("mesh_key") is None:
+        args = (
+            inputs["store"],
+            jnp.asarray(inputs["idx"]),
+            jnp.asarray(inputs["ok"]),
+            jnp.asarray(inputs["r"]),
+            jnp.asarray(inputs["s"]),
+            jnp.asarray(inputs["k"]),
+        )
+        return _compiled_kernel_resident(m, backend, mul_impl)(*args), None
+    # Context mismatch: materialize the needed columns and take the
+    # gathered-table kernel (counted as real per-batch table H2D).
+    tab_host = np.asarray(inputs["store"])
+    tab = np.ascontiguousarray(tab_host[:, :, :, np.asarray(inputs["idx"])])
+    resident.note_table_h2d(tab.nbytes)
+    ginputs = dict(
+        tab=tab, ok=inputs["ok"], r=inputs["r"], s=inputs["s"], k=inputs["k"]
+    )
+    return _run_chunk_tables(ginputs, backend, None)
 
 
 # --- host-side preparation --------------------------------------------------
@@ -637,18 +737,54 @@ def _s_canonical(s_arr: np.ndarray) -> np.ndarray:
     return canonical_lt(s_arr, _L_BYTES_BE)
 
 
+def _challenge_k(
+    prefix: np.ndarray,
+    msgs: Sequence[bytes],
+    backend: Optional[str],
+    stage_times: Optional[dict] = None,
+) -> np.ndarray:
+    """Challenge scalars k = SHA-512(R‖A‖M) mod L for well-formed lanes.
+
+    Prefers the fused on-device kernel (ops/hash512) — fixed-width vote
+    batches hash on the accelerator and the host's share of prep shrinks
+    to byte packing — with the hashlib/C-extension host path as exact
+    fallback. ``stage_times`` (bench) accumulates the hashing wall time
+    under ``hash_ms`` plus which path ran, so prep_ms can be split into
+    hash vs pack.
+    """
+    import time as _time
+
+    from tendermint_tpu.crypto.hashing import reduce_mod_l, sha512_batch_prefixed
+    from tendermint_tpu.ops import hash512
+
+    t0 = _time.perf_counter()
+    k_dev = hash512.try_challenge_device(prefix, msgs, backend)
+    if k_dev is not None:
+        k_arr = np.asarray(k_dev)
+        device = True
+    else:
+        k_arr = reduce_mod_l(sha512_batch_prefixed(prefix, list(msgs)))
+        device = False
+    if stage_times is not None:
+        stage_times["hash_ms"] = stage_times.get("hash_ms", 0.0) + (
+            _time.perf_counter() - t0
+        ) * 1000.0
+        stage_times["hash_device"] = device
+    return k_arr
+
+
 def prepare_batch(
     pubkeys: Sequence[bytes],
     msgs: Sequence[bytes],
     sigs: Sequence[bytes],
     pad_to: Optional[int] = None,
+    backend: Optional[str] = None,
+    stage_times: Optional[dict] = None,
 ) -> Tuple[dict, np.ndarray]:
     """Host prep: batch-hash challenges, stack raw bytes, pad to bucket.
 
     Returns (device inputs dict of (M,32) uint8 arrays, host_ok (N,)
     bool of structural checks: lengths and s < L canonicity)."""
-    from tendermint_tpu.crypto.hashing import reduce_mod_l, sha512_batch_prefixed
-
     n = len(pubkeys)
     len_ok = all(len(pk) == 32 and len(sg) == 64 for pk, sg in zip(pubkeys, sigs))
     if len_ok:
@@ -659,7 +795,7 @@ def prepare_batch(
         r_arr, s_arr = sig_arr[:, :32], sig_arr[:, 32:]
         host_ok = _s_canonical(s_arr)
         prefix = np.concatenate([r_arr, pk_arr], axis=1)  # (n, 64) = R || A
-        k_arr = reduce_mod_l(sha512_batch_prefixed(prefix, list(msgs)))
+        k_arr = _challenge_k(prefix, msgs, backend, stage_times)
     else:
         host_ok = np.ones(n, dtype=bool)
         pk_arr = np.zeros((n, 32), dtype=np.uint8)
@@ -705,20 +841,20 @@ def _prep_table_chunk(
     tabs: Sequence[np.ndarray],
     oks: Sequence[bool],
     pad_to: int,
+    backend: Optional[str] = None,
+    stage_times: Optional[dict] = None,
 ) -> Tuple[dict, np.ndarray]:
     """Host prep for a cache-hit chunk: hash challenges, stack the
     gathered per-key table columns into the kernel's (8, 4, 32, M)
     uint8 input. Lengths are pre-validated by the caller (ill-formed
     lanes stay on the legacy path)."""
-    from tendermint_tpu.crypto.hashing import reduce_mod_l, sha512_batch_prefixed
-
     n = len(pks)
     pk_arr = np.frombuffer(b"".join(pks), dtype=np.uint8).reshape(n, 32)
     sig_arr = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(n, 64)
     r_arr, s_arr = sig_arr[:, :32], sig_arr[:, 32:]
     host_ok = _s_canonical(s_arr)
     prefix = np.concatenate([r_arr, pk_arr], axis=1)  # (n, 64) = R || A
-    k_arr = reduce_mod_l(sha512_batch_prefixed(prefix, list(msgs)))
+    k_arr = _challenge_k(prefix, msgs, backend, stage_times)
     tab = np.stack(tabs)  # (n, 8, 4, 32) uint8
     a_ok = np.fromiter(oks, dtype=bool, count=n).astype(np.uint8)
     if pad_to > n:
@@ -732,7 +868,57 @@ def _prep_table_chunk(
         )
         a_ok = np.concatenate([a_ok, np.ones(pad_to - n, dtype=np.uint8)])
     tab = np.ascontiguousarray(tab.transpose(1, 2, 3, 0))  # (8, 4, 32, M)
+    # every gathered chunk re-ships its table tensor; the resident store
+    # accounts it so benches can prove the steady-state delta
+    from tendermint_tpu.ops import resident
+
+    resident.note_table_h2d(tab.nbytes)
     inputs = dict(tab=tab, ok=a_ok, r=r_arr, s=s_arr, k=k_arr)
+    return inputs, host_ok
+
+
+def _prep_resident_chunk(
+    pks: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    idxs: np.ndarray,
+    oks: np.ndarray,
+    store_tab,
+    mesh_key,
+    pad_to: int,
+    backend: Optional[str] = None,
+    stage_times: Optional[dict] = None,
+) -> Tuple[dict, np.ndarray]:
+    """Host prep for a resident-store chunk: the table tensor is already
+    on device, so the per-batch payload is the (M,) int32 gather index
+    vector plus the usual r/s/k rows. Pad lanes index column 0 — the
+    pad-key table reserved at upload."""
+    n = len(pks)
+    pk_arr = np.frombuffer(b"".join(pks), dtype=np.uint8).reshape(n, 32)
+    sig_arr = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(n, 64)
+    r_arr, s_arr = sig_arr[:, :32], sig_arr[:, 32:]
+    host_ok = _s_canonical(s_arr)
+    prefix = np.concatenate([r_arr, pk_arr], axis=1)  # (n, 64) = R || A
+    k_arr = _challenge_k(prefix, msgs, backend, stage_times)
+    idx = np.asarray(idxs, dtype=np.int32)
+    a_ok = np.asarray(oks, dtype=np.uint8)
+    if pad_to > n:
+        _, r_row, s_row, k_row = _pad_rows()
+        reps = (pad_to - n, 1)
+        r_arr = np.concatenate([r_arr, np.tile(r_row, reps)])
+        s_arr = np.concatenate([s_arr, np.tile(s_row, reps)])
+        k_arr = np.concatenate([k_arr, np.tile(k_row, reps)])
+        idx = np.concatenate([idx, np.zeros(pad_to - n, dtype=np.int32)])
+        a_ok = np.concatenate([a_ok, np.ones(pad_to - n, dtype=np.uint8)])
+    inputs = dict(
+        store=store_tab,
+        mesh_key=mesh_key,
+        idx=idx,
+        ok=a_ok,
+        r=r_arr,
+        s=s_arr,
+        k=k_arr,
+    )
     return inputs, host_ok
 
 
@@ -805,7 +991,12 @@ def _mesh_collect_retry(job: "_Job", backend: Optional[str], exc: Exception):
             f"device {culprit} excluded, retrying on a {nxt.n_dev}-device mesh"
         )
         inputs, _ = job.prepped
-        runner = _run_chunk_tables if job.kind == "tables" else _run_chunk
+        if job.kind == "tables":
+            runner = _run_chunk_tables
+        elif job.kind == "resident":
+            runner = _run_chunk_resident
+        else:
+            runner = _run_chunk
         out, used = runner(inputs, backend, nxt)
         ok = (
             mesh_sharding.collect_sharded(out, "ed25519")
@@ -939,8 +1130,30 @@ def _verify_uncached(
     span = CHUNK * plan.n_dev if plan is not None else CHUNK
     mesh_used = False
 
+    # Resident routing: lanes whose key already lives in the device-
+    # resident store ship only gather indices — zero per-batch table
+    # H2D. Any trouble leaves every cached lane on the gathered path.
+    res_idx = res_ok_cols = res_tab = res_mesh_key = None
+    res_mask = np.zeros(n, dtype=bool)
+    if entries is not None:
+        try:
+            from tendermint_tpu.ops import resident
+
+            res = resident.acquire(
+                pubkeys, has_table, plan=plan, backend=backend
+            )
+        except Exception:  # resident path is an optimization, never a gate
+            res = None
+        if res is not None:
+            res_mask, res_idx, res_ok_cols, res_tab, res_mesh_key = res
+    table_mask = has_table & ~res_mask
+
     jobs = [
-        _Job("tables", rows) for rows in _chunk_rows(np.nonzero(has_table)[0], span)
+        _Job("resident", rows)
+        for rows in _chunk_rows(np.nonzero(res_mask)[0], span)
+    ]
+    jobs += [
+        _Job("tables", rows) for rows in _chunk_rows(np.nonzero(table_mask)[0], span)
     ]
     jobs += [
         _Job("legacy", rows) for rows in _chunk_rows(np.nonzero(~has_table)[0], span)
@@ -962,6 +1175,19 @@ def _verify_uncached(
                 if plan is not None
                 else _bucket(len(job.rows))
             )
+            if job.kind == "resident":
+                idxs = res_idx[job.rows]
+                return _prep_resident_chunk(
+                    pks,
+                    ms,
+                    sgs,
+                    idxs,
+                    res_ok_cols[idxs],
+                    res_tab,
+                    res_mesh_key,
+                    pad_to,
+                    backend=backend,
+                )
             if job.kind == "tables":
                 return _prep_table_chunk(
                     pks,
@@ -970,8 +1196,9 @@ def _verify_uncached(
                     [entries[i][0] for i in job.rows],
                     [entries[i][1] for i in job.rows],
                     pad_to,
+                    backend=backend,
                 )
-            return prepare_batch(pks, ms, sgs, pad_to=pad_to)
+            return prepare_batch(pks, ms, sgs, pad_to=pad_to, backend=backend)
 
     results = np.ones(n, dtype=bool)
     host_ok_all = np.ones(n, dtype=bool)
@@ -1005,9 +1232,12 @@ def _verify_uncached(
                 attempt = health.begin_attempt("ed25519")
             if attempt is not None:
                 try:
-                    runner = (
-                        _run_chunk_tables if job.kind == "tables" else _run_chunk
-                    )
+                    if job.kind == "tables":
+                        runner = _run_chunk_tables
+                    elif job.kind == "resident":
+                        runner = _run_chunk_resident
+                    else:
+                        runner = _run_chunk
                     with tracing.span(
                         "dispatch_chunk",
                         stage="dispatch",

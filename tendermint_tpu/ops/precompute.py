@@ -61,6 +61,31 @@ _RESULT_CAP_ENV = "TENDERMINT_TPU_RESULT_CACHE_CAP"
 
 _ACTIVE_SETS_CAP = 8  # distinct validator sets considered live at once
 
+# Cache-event observers (device-resident mirrors register here so host
+# invalidation propagates to device copies in lockstep).  This module
+# stays jax-free: observers are plain callables ``fn(kind, payload)``
+# with kind in {"rotation", "evict", "clear"} and payload a tuple of the
+# affected pubkeys (empty for "clear").  Callbacks fire OUTSIDE the
+# cache lock — never call back into the cache from an observer without
+# expecting fresh state.
+_observers_lock = threading.Lock()
+_observers: List = []  # guarded-by: _observers_lock
+
+
+def register_observer(fn) -> None:
+    """Subscribe ``fn(kind, payload)`` to table-cache invalidation events."""
+    with _observers_lock:
+        if fn not in _observers:
+            _observers.append(fn)
+
+
+def unregister_observer(fn) -> None:
+    with _observers_lock:
+        try:
+            _observers.remove(fn)
+        except ValueError:  # already gone — unsubscribe is idempotent
+            pass
+
 
 def _mode() -> str:
     return os.environ.get(_MODE_ENV, "auto").lower()
@@ -164,6 +189,7 @@ class PrecomputeCache:
         self.evictions = 0  # guarded-by: _lock
         self.invalidations = 0  # guarded-by: _lock
         self.build_seconds = 0.0  # guarded-by: _lock
+        self._pending_events: List[Tuple[str, tuple]] = []  # guarded-by: _lock
 
     # --- configuration ------------------------------------------------------
 
@@ -177,6 +203,31 @@ class PrecomputeCache:
     def bind_metrics(self, metrics) -> None:
         with self._lock:
             self._metrics = metrics
+
+    def _flush_events(self) -> None:
+        """Deliver queued invalidation events to registered observers.
+
+        Events are appended under ``_lock`` but delivered outside it
+        (same pattern as the metrics flush in :meth:`gather`): observers
+        upload/drop device tensors, which must never run under the cache
+        lock (lock-order sanitizer: no IO/device work under ``_lock``).
+        """
+        with self._lock:
+            if not self._pending_events:
+                return
+            events = self._pending_events
+            self._pending_events = []
+        with _observers_lock:
+            observers = list(_observers)
+        for kind, payload in events:
+            for fn in observers:
+                try:
+                    fn(kind, payload)
+                except Exception:
+                    # An observer failure must not poison the verify hot
+                    # path; the resident store fails safe (lanes fall
+                    # back to the gathered-table path).
+                    pass
 
     # --- validator-set awareness -------------------------------------------
 
@@ -202,13 +253,16 @@ class PrecomputeCache:
             while len(self._active_sets) > _ACTIVE_SETS_CAP:
                 self._active_sets.popitem(last=False)
             self._recompute_eligible_locked()
-            return True
+            newly = True
+        self._flush_events()
+        return newly
 
     def pin(self, pubkeys: Iterable[bytes]) -> None:
         """Make specific keys table-eligible outside any validator set."""
         with self._lock:
             self._pinned.update(bytes(pk) for pk in pubkeys)
             self._recompute_eligible_locked()
+        self._flush_events()
 
     def _recompute_eligible_locked(self) -> None:
         eligible = set(self._pinned)
@@ -221,6 +275,7 @@ class PrecomputeCache:
                 del self._entries[pk]
             if stale:
                 self.invalidations += len(stale)
+                self._pending_events.append(("rotation", tuple(stale)))
                 if self._metrics is not None:
                     self._metrics.precompute_invalidations.inc(len(stale))
 
@@ -237,10 +292,28 @@ class PrecomputeCache:
         self._entries.move_to_end(pk)
         cap = self.cap
         while len(self._entries) > cap:
-            self._entries.popitem(last=False)
+            old_pk, _ = self._entries.popitem(last=False)
             self.evictions += 1
+            self._pending_events.append(("evict", (old_pk,)))
             if self._metrics is not None:
                 self._metrics.precompute_evictions.inc()
+
+    def snapshot_eligible(self) -> List[Tuple[bytes, np.ndarray, bool]]:
+        """(pk, table, ok) for every cached key of a live validator set.
+
+        The device-resident mirror uploads exactly this slice: eligible
+        keys whose host tables already exist.  No LRU touch and no
+        hit/miss accounting — this is a replication read, not a lookup.
+        """
+        with self._lock:
+            if _mode() == "all":
+                keys = list(self._entries)
+            else:
+                keys = [pk for pk in self._entries if pk in self._eligible]
+            return [
+                (pk, self._entries[pk][0], self._entries[pk][1])
+                for pk in keys
+            ]
 
     def lookup(self, pk: bytes) -> Optional[Tuple[np.ndarray, bool]]:
         with self._lock:
@@ -316,6 +389,7 @@ class PrecomputeCache:
                 if builds:
                     metrics.precompute_builds.inc(builds)
                     metrics.table_build_seconds.observe(build_time)
+        self._flush_events()
         if not has_table.any():
             return None, has_table
         return entries, has_table
@@ -351,6 +425,8 @@ class PrecomputeCache:
             self._active_sets.clear()
             self._pinned.clear()
             self._eligible = frozenset()
+            self._pending_events.append(("clear", ()))
+        self._flush_events()
         self.reset_stats()
 
 

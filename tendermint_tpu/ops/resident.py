@@ -1,0 +1,404 @@
+"""Device-resident precompute table store for the verify hot path.
+
+ops/precompute.py keeps the per-validator ``[1..8](-A)`` signed-window
+tables on the *host*; until now every batch re-gathered the cached
+columns and re-shipped a fresh ``(8, 4, 32, N)`` uint8 tensor to the
+device — ~1 KiB per lane per call, even when the same 100-validator
+committee signs every commit. This module closes that loop: the live
+validator set's tables are uploaded **once** as a ``(8, 4, 32, K)``
+device tensor, and steady-state batches ship only per-lane ``int32``
+gather indices into it (ops/ed25519_batch.verify_kernel_resident does
+the ``jnp.take`` on device). Rotation and LRU eviction invalidate the
+device copy in lockstep with the host cache via the observer hook
+(:func:`precompute.register_observer`) — a stale tensor can never
+verify a rotated-out key because any change to the host entries drops
+the device copy wholesale.
+
+Sharding: when a mesh is planned the store is uploaded **replicated**
+across the plan's devices (``P(None, None, None, None)``): the store
+axis is *distinct keys*, not lanes, and a replicated store makes the
+per-lane gather device-local, so the in-kernel gathered table tensor
+comes out lane-sharded ``P(None, None, None, 'sig')`` with zero
+collectives — same layout the sharded table kernel always used. A
+committee's worth of tables is ~100 KiB; replication is cheaper than
+one cross-device gather.
+
+Column 0 is reserved for the pad-lane table so padded lanes index
+something valid; real keys start at column 1.
+
+Env knob / config::
+
+    TENDERMINT_TPU_RESIDENT   auto (default: on for tpu/axon) | on | off
+    [ops] resident_tables     same values, via node config -> configure()
+
+This module fails safe everywhere: any trouble (no device, upload
+failure, mesh mismatch) returns None from :func:`acquire` and the
+caller keeps the per-batch gathered-table path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tendermint_tpu.libs import tracing
+
+_ENV = "TENDERMINT_TPU_RESIDENT"
+
+# Keys seen this many times via note_hot_keys get pinned in the host
+# cache (verifyd traffic has no validator-set activation to ride).
+_HOT_PIN_THRESHOLD = 2
+_HOT_TRACK_CAP = 4096
+
+
+def _platform(backend: Optional[str]) -> str:
+    try:
+        import jax
+
+        if backend:
+            return jax.local_devices(backend=backend)[0].platform
+        return jax.default_backend()
+    except Exception:
+        return "unknown"
+
+
+class ResidentTableStore:
+    """Thread-safe device mirror of the host precompute cache."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._mode_override: Optional[str] = None  # guarded-by: _lock
+        self._index: Dict[bytes, int] = {}  # guarded-by: _lock
+        self._tab_dev = None  # guarded-by: _lock  (8,4,32,K) device uint8
+        self._ok_host: Optional[np.ndarray] = None  # guarded-by: _lock
+        self._mesh_key: Optional[tuple] = None  # guarded-by: _lock
+        self._backend_key: Optional[str] = None  # guarded-by: _lock
+        self._version = 0  # guarded-by: _lock
+        self._metrics = None  # guarded-by: _lock
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.uploads = 0  # guarded-by: _lock
+        self.h2d_bytes = 0  # guarded-by: _lock
+        self.gathered_h2d_bytes = 0  # guarded-by: _lock
+        self.invalidations = 0  # guarded-by: _lock
+        self._hot_counts: Dict[bytes, int] = {}  # guarded-by: _lock
+
+    # --- configuration ------------------------------------------------------
+
+    def configure(self, mode: Optional[str]) -> None:
+        """Config-file override of the env knob (``[ops] resident_tables``)."""
+        with self._lock:
+            self._mode_override = mode.lower() if mode else None
+
+    def mode(self) -> str:
+        with self._lock:
+            override = self._mode_override
+        if override:
+            return override
+        return os.environ.get(_ENV, "auto").lower()
+
+    def enabled(self, backend: Optional[str] = None) -> bool:
+        m = self.mode()
+        if m in ("1", "on", "true", "yes", "all"):
+            return True
+        if m in ("0", "off", "none", "false"):
+            return False
+        # auto: accelerator backends only — CPU ships tables per batch
+        # exactly as before, so tier-1 behavior is unchanged.
+        return _platform(backend) in ("tpu", "axon")
+
+    def bind_metrics(self, metrics) -> None:
+        with self._lock:
+            self._metrics = metrics
+
+    # --- upload / invalidate ------------------------------------------------
+
+    def _context_key(self, plan, backend: Optional[str]) -> Tuple[Optional[tuple], Optional[str]]:
+        if plan is not None:
+            return tuple(plan.device_ids), None
+        return None, backend
+
+    def refresh(self, plan=None, backend: Optional[str] = None) -> bool:
+        """Upload the host cache's live-committee slice to the device.
+
+        Builds the ``(8, 4, 32, K)`` tensor on host (column 0 = pad
+        table), ships it once, and installs it unless an invalidation
+        raced the upload (version check). Returns True when a usable
+        device copy is installed.
+        """
+        from tendermint_tpu.ops import ed25519_batch, precompute
+
+        snap = precompute.tables.snapshot_eligible()
+        if not snap:
+            return False
+        mesh_key, backend_key = self._context_key(plan, backend)
+        with self._lock:
+            version = self._version
+        cols = [ed25519_batch._pad_table()]
+        oks = [True]
+        index: Dict[bytes, int] = {}
+        for pk, table, ok in snap:
+            index[pk] = len(cols)
+            cols.append(table)
+            oks.append(ok)
+        host_tab = np.ascontiguousarray(
+            np.stack(cols).transpose(1, 2, 3, 0)
+        )  # (8, 4, 32, K)
+        nbytes = int(host_tab.nbytes)
+        try:
+            with tracing.span(
+                "resident_upload",
+                stage="resident_upload",
+                engine="ed25519",
+                keys=len(index),
+                bytes=nbytes,
+            ):
+                tab_dev = self._device_put(host_tab, plan, backend)
+        except Exception:  # upload is an optimization; fail safe to gather
+            return False
+        with self._lock:
+            if self._version != version:
+                # an invalidation raced the upload: the snapshot may be
+                # stale, drop it and let the next batch retry
+                return False
+            self._index = index
+            self._tab_dev = tab_dev
+            self._ok_host = np.asarray(oks, dtype=np.uint8)
+            self._mesh_key = mesh_key
+            self._backend_key = backend_key
+            self.uploads += 1
+            self.h2d_bytes += nbytes
+            metrics = self._metrics
+        if metrics is not None:
+            metrics.table_h2d_bytes.inc(nbytes)
+        return True
+
+    @staticmethod
+    def _device_put(host_tab: np.ndarray, plan, backend: Optional[str]):
+        import jax
+
+        if plan is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return jax.device_put(
+                host_tab,
+                NamedSharding(plan.mesh, PartitionSpec(None, None, None, None)),
+            )
+        dev = jax.local_devices(backend=backend)[0] if backend else None
+        if dev is not None:
+            return jax.device_put(host_tab, dev)
+        return jax.device_put(host_tab)
+
+    def invalidate(self, pubkeys: Iterable[bytes]) -> None:
+        """Host cache dropped these keys: the device copy dies with them."""
+        keys = [bytes(pk) for pk in pubkeys]
+        with self._lock:
+            if self._tab_dev is None:
+                return
+            if not any(pk in self._index for pk in keys):
+                return
+            self._drop_locked()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._drop_locked()
+            self._hot_counts.clear()
+
+    def _drop_locked(self) -> None:
+        if self._tab_dev is not None:
+            self.invalidations += 1
+        self._index = {}
+        self._tab_dev = None
+        self._ok_host = None
+        self._mesh_key = None
+        self._backend_key = None
+        self._version += 1
+
+    # --- lookup -------------------------------------------------------------
+
+    def acquire(
+        self,
+        pubkeys: Sequence[bytes],
+        has_table: np.ndarray,
+        plan=None,
+        backend: Optional[str] = None,
+    ):
+        """Resident routing for one batch.
+
+        For lanes with a host-cached table (``has_table``), answers
+        which can ride the resident kernel: returns ``(res_mask, idx,
+        ok, tab_dev, mesh_key)`` where ``res_mask`` is the (N,) bool
+        lane partition, ``idx``/``ok`` are full-length per-lane arrays
+        (garbage outside the mask), and ``tab_dev`` is the device
+        tensor. Returns None when the resident path is off, empty, or
+        uploaded for a different mesh/backend context.
+        """
+        if not self.enabled(backend):
+            return None
+        n = len(pubkeys)
+        want_key = self._context_key(plan, backend)
+        with self._lock:
+            stale = self._tab_dev is None or (
+                (self._mesh_key, self._backend_key) != want_key
+            )
+            if not stale:
+                # committee growth: a host-cached key the store has not
+                # seen yet means the upload predates it — refresh once
+                # so new validators join the resident tensor
+                index = self._index
+                stale = any(
+                    has_table[i] and bytes(pubkeys[i]) not in index
+                    for i in range(n)
+                )
+        if stale:
+            if not self.refresh(plan=plan, backend=backend):
+                return None
+        with self._lock:
+            tab_dev = self._tab_dev
+            ok_host = self._ok_host
+            index = self._index
+            if tab_dev is None or (
+                (self._mesh_key, self._backend_key) != want_key
+            ):
+                return None
+            idx = np.zeros(n, dtype=np.int32)
+            res_mask = np.zeros(n, dtype=bool)
+            hits = misses = 0
+            for i in range(n):
+                if not has_table[i]:
+                    continue
+                col = index.get(bytes(pubkeys[i]))
+                if col is None:
+                    misses += 1
+                    continue
+                idx[i] = col
+                res_mask[i] = True
+                hits += 1
+            self.hits += hits
+            self.misses += misses
+            metrics = self._metrics
+        if metrics is not None:
+            if hits:
+                metrics.table_resident_hits.inc(hits)
+            if misses:
+                metrics.table_resident_misses.inc(misses)
+        if not res_mask.any():
+            return None
+        return res_mask, idx, ok_host, tab_dev, want_key[0]
+
+    # --- verifyd / accounting hooks ----------------------------------------
+
+    def note_hot_keys(self, pubkeys: Iterable[bytes]) -> None:
+        """Count repeat signers from set-less traffic (verifyd): a key
+        seen ``_HOT_PIN_THRESHOLD`` times gets pinned in the host cache
+        so it joins the next resident upload."""
+        to_pin = []
+        with self._lock:
+            for pk in pubkeys:
+                pk = bytes(pk)
+                if len(pk) != 32:
+                    continue
+                c = self._hot_counts.get(pk, 0) + 1
+                if c >= _HOT_PIN_THRESHOLD:
+                    self._hot_counts.pop(pk, None)
+                    to_pin.append(pk)
+                elif len(self._hot_counts) < _HOT_TRACK_CAP:
+                    self._hot_counts[pk] = c
+        if to_pin:
+            from tendermint_tpu.ops import precompute
+
+            precompute.pin_pubkeys(to_pin)
+
+    def note_table_h2d(self, nbytes: int) -> None:
+        """Account a gathered-table (non-resident) per-batch upload."""
+        with self._lock:
+            self.gathered_h2d_bytes += int(nbytes)
+            metrics = self._metrics
+        if metrics is not None:
+            metrics.table_h2d_bytes.inc(int(nbytes))
+
+    # --- introspection ------------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "resident_keys": len(self._index),
+                "hits": self.hits,
+                "misses": self.misses,
+                "uploads": self.uploads,
+                "h2d_bytes": self.h2d_bytes,
+                "gathered_h2d_bytes": self.gathered_h2d_bytes,
+                "invalidations": self.invalidations,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._drop_locked()
+            self._hot_counts.clear()
+            self.hits = self.misses = self.uploads = 0
+            self.h2d_bytes = self.gathered_h2d_bytes = 0
+            self.invalidations = 0
+
+
+# --- process-wide singleton --------------------------------------------------
+
+store = ResidentTableStore()
+
+
+def _on_cache_event(kind: str, payload: tuple) -> None:
+    """precompute.py observer: host invalidation -> device invalidation."""
+    if kind in ("rotation", "evict"):
+        store.invalidate(payload)
+    elif kind == "clear":
+        store.clear()
+
+
+def _install_observer() -> None:
+    from tendermint_tpu.ops import precompute
+
+    precompute.register_observer(_on_cache_event)
+
+
+_install_observer()
+
+
+def acquire(pubkeys, has_table, plan=None, backend=None):
+    return store.acquire(pubkeys, has_table, plan=plan, backend=backend)
+
+
+def enabled(backend: Optional[str] = None) -> bool:
+    return store.enabled(backend)
+
+
+def configure(mode: Optional[str]) -> None:
+    store.configure(mode)
+
+
+def bind_metrics(metrics) -> None:
+    store.bind_metrics(metrics)
+
+
+def note_hot_keys(pubkeys: Iterable[bytes]) -> None:
+    store.note_hot_keys(pubkeys)
+
+
+def note_table_h2d(nbytes: int) -> None:
+    store.note_table_h2d(nbytes)
+
+
+def note_validator_rotation() -> None:
+    """Consensus noticed a validator-set change before the host cache
+    did (crypto/batch.note_validator_set): drop the device copy now so
+    the next batch re-uploads against the fresh committee."""
+    store.clear()
+
+
+def stats() -> Dict[str, float]:
+    return store.stats()
+
+
+def reset() -> None:
+    store.reset()

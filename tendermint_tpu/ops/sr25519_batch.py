@@ -231,10 +231,13 @@ def verify_batch_sr(
         m = _mesh_bucket(n, plan.n_dev) if plan is not None else _bucket(n)
         mesh_used = False
         pad = _pad_entry() if m > n else None
-        from tendermint_tpu.ops.ed25519_batch import active_impl
+        from tendermint_tpu.ops.ed25519_batch import (
+            _mul_impl_for_chunk,
+            active_impl,
+        )
 
         impl = active_impl(backend)
-        mul_impl = "mxu" if impl == "mxu" else field.get_mul_impl()
+        mul_impl = _mul_impl_for_chunk(impl, backend, m)
     except Exception as exc:
         # Host-side prep failure before any device work.
         health.record_failure(exc, attempt)

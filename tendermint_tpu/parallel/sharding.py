@@ -83,6 +83,22 @@ def _sharded_kernel(mesh: Mesh, kind: str, mul_impl: str):
             in_shardings=(tab, lane, rows, rows, rows),
             out_shardings=lane,
         )
+    if kind == "resident":
+        # The resident store (8, 4, 32, K) is keyed by distinct pubkey,
+        # not lane: replicate it (a committee is ~100 KiB) so the
+        # per-lane take is device-local; the gathered tensor inside the
+        # kernel comes out lane-sharded like the "tables" input.
+        tab_rep = NamedSharding(mesh, P(None, None, None, None))
+
+        def run_resident(t, idx, ok, r, s, k):
+            with field.pinned_mul_impl(mul_impl):
+                return ed25519_batch.verify_kernel_resident(t, idx, ok, r, s, k)
+
+        return jax.jit(
+            run_resident,
+            in_shardings=(tab_rep, lane, lane, rows, rows, rows),
+            out_shardings=lane,
+        )
     if kind == "sr25519":
         from tendermint_tpu.ops import sr25519_batch
 
@@ -128,6 +144,18 @@ def _pad_for_mesh(kind: str, inputs: dict, n_dev: int) -> Tuple[dict, int]:
                 [np.asarray(inputs[key]), np.tile(row.reshape(1, 32), (extra, 1))]
             )
         return out, target
+    if kind == "resident":
+        # the store tensor is untouched — pad lanes index column 0 (the
+        # pad-key table reserved at upload)
+        idx = np.asarray(inputs["idx"])
+        out["idx"] = np.concatenate([idx, np.zeros(extra, dtype=idx.dtype)])
+        ok = np.asarray(inputs["ok"])
+        out["ok"] = np.concatenate([ok, np.ones(extra, dtype=ok.dtype)])
+        for key, row in zip(("r", "s", "k"), ed25519_batch._pad_rows()[1:]):
+            out[key] = np.concatenate(
+                [np.asarray(inputs[key]), np.tile(row, (extra, 1))]
+            )
+        return out, target
     if kind == "tables":
         pad_tab = ed25519_batch._pad_table()  # (8, 4, 32) uint8
         out["tab"] = np.concatenate(
@@ -150,6 +178,15 @@ def _pad_for_mesh(kind: str, inputs: dict, n_dev: int) -> Tuple[dict, int]:
 
 
 def _kernel_args(kind: str, inputs: dict) -> tuple:
+    if kind == "resident":
+        return (
+            inputs["store"],
+            inputs["idx"],
+            inputs["ok"],
+            inputs["r"],
+            inputs["s"],
+            inputs["k"],
+        )
     if kind == "tables":
         return (inputs["tab"], inputs["ok"], inputs["r"], inputs["s"], inputs["k"])
     return (inputs["pk"], inputs["r"], inputs["s"], inputs["k"])
@@ -202,6 +239,14 @@ def run_chunk_mesh(
             if nxt is None:
                 raise MeshUnavailableError(
                     f"device {culprit} excluded and no usable mesh remains"
+                ) from exc
+            if kind == "resident":
+                # the resident store tensor is committed to THIS mesh;
+                # a rebuilt smaller mesh can't consume it — hand back so
+                # the engine re-ships this chunk's columns explicitly
+                raise MeshUnavailableError(
+                    f"device {culprit} excluded; resident store is bound "
+                    "to the dead mesh"
                 ) from exc
             warnings.warn(
                 f"sharded {kind} chunk failed on device {culprit} ({exc!r}); "

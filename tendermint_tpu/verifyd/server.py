@@ -209,7 +209,11 @@ class VerifydServer:
                 sched = VerifyScheduler(
                     verify_fn,
                     fallback_fn=fallback_fn,
-                    on_flush=self._on_flush,
+                    on_flush=(
+                        lambda reason, batch, seconds, _algo=algo: (
+                            self._on_flush(reason, batch, seconds, _algo)
+                        )
+                    ),
                     **self._sched_args,
                 )
                 sched.start()
@@ -218,11 +222,26 @@ class VerifydServer:
 
     # --- flush observer -----------------------------------------------------
 
-    def _on_flush(self, reason: str, batch: list, seconds: float) -> None:
+    def _on_flush(
+        self, reason: str, batch: list, seconds: float, algo: int = ALGO_ED25519
+    ) -> None:
         lanes = len(batch)
         self.admission.observe_flush(lanes, seconds)
         self.metrics.flushes.labels(reason=reason).inc()
         self.metrics.batch_occupancy.observe(lanes)
+        if algo == ALGO_ED25519:
+            # Repeat signers from set-less verifyd traffic feed the
+            # device-resident table store's hot-key pinning
+            # (ops/resident.py); the import stays lazy + guarded so a
+            # host-only daemon config never pays for the ops engine.
+            try:
+                from tendermint_tpu.ops import resident
+
+                resident.note_hot_keys(p.pubkey for p in batch)
+            except Exception:
+                # accounting hook only — a broken ops import must never
+                # touch the serving path
+                pass
         if len({p.tag for p in batch}) > 1:
             with self._stats_mtx:
                 self.cross_client_flushes[reason] = (

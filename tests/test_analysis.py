@@ -130,6 +130,25 @@ class TestLockDiscipline:
         found = run_on(LockDisciplineChecker(), {"m.py": src})
         assert codes(found) == ["TPL003"]
 
+    def test_module_global_guarded_by_module_lock_accepted(self):
+        """The ops singleton pattern: a top-level global annotated with
+        a module-scope lock is a legitimate annotation, not an orphan."""
+        src = """
+            import threading
+
+            _lock = threading.Lock()
+            _selected = {}  # guarded-by: _lock
+            _count = 0  # guarded-by: none(single-writer stats)
+        """
+        assert run_on(LockDisciplineChecker(), {"m.py": src}) == []
+
+    def test_module_global_guarded_by_unknown_lock_is_orphan(self):
+        src = """
+            _selected = {}  # guarded-by: _lock
+        """
+        found = run_on(LockDisciplineChecker(), {"m.py": src})
+        assert codes(found) == ["TPL003"]
+
     def test_locked_suffix_methods_assume_lock_held(self):
         src = """
             import threading
@@ -309,6 +328,67 @@ class TestJaxPurity:
         """
         found = self.run(src)
         assert codes(found) == ["TPJ001"]
+
+    def test_jit_factory_closure_is_entry_point(self):
+        """jax.jit(factory(...)) — the autotuner's timing-kernel shape —
+        must resolve through the factory to the returned closure."""
+        src = """
+            import time
+            import jax
+
+            def make_chain(n):
+                def chain(a, b):
+                    time.sleep(0)
+                    return a + b
+                return chain
+
+            compiled = jax.jit(make_chain(8))
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPJ001"]
+        assert "time.sleep" in found[0].message
+
+    def test_jit_factory_clean_closure_passes(self):
+        src = """
+            import jax
+
+            def make_chain(n):
+                def chain(a, b):
+                    return a + b
+                return chain
+
+            compiled = jax.jit(make_chain(8))
+        """
+        assert self.run(src) == []
+
+    def test_bare_from_import_alias_flagged(self):
+        """from time import perf_counter: the bare call is as impure as
+        the dotted one."""
+        src = """
+            from time import perf_counter
+
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                t = perf_counter()
+                return x + t
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPJ001"]
+        assert "time.perf_counter" in found[0].message
+
+    def test_benign_from_import_alias_passes(self):
+        src = """
+            from functools import lru_cache
+
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x
+        """
+        assert self.run(src) == []
 
 
 # --- wire compat -------------------------------------------------------------

@@ -1,0 +1,186 @@
+"""Fused on-device SHA-512 challenge hashing (ops/hash512.py).
+
+The kernel must be bit-exact with hashlib: parity is asserted at every
+Merkle-Damgard padding boundary (0/55/56/64/111/112/128 bytes — the
+lengths where the 0x80 terminator and the 128-bit length field spill
+into a new block), on sr25519-style prefixed challenge inputs, and — in
+the slow battery — across 10k random messages grouped by length. The
+fallback ladder (mixed lengths, oversize lanes, broken kernel, disabled
+env) must always land on the host path, never wrong answers.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto.hashing import reduce_mod_l, sha512_batch_prefixed
+from tendermint_tpu.ops import ed25519_batch, hash512
+
+# Padding boundaries for SHA-512's 128-byte blocks: empty input; 55/56
+# straddle nothing for SHA-512 but mirror the SHA-256 battery; 111 is
+# the last single-block length, 112 forces the length field into a
+# second block, 128 is an exact block.
+BOUNDARY_LENGTHS = (0, 55, 56, 64, 111, 112, 128)
+
+
+@pytest.fixture(autouse=True)
+def _device_hash_on(monkeypatch):
+    """Force the fused path on (auto keeps CPU off) and reset the
+    sticky-broken flag and lane counter between tests."""
+    monkeypatch.setenv("TENDERMINT_TPU_DEVICE_HASH", "1")
+    monkeypatch.setattr(hash512, "_BROKEN", False)
+    hash512.reset_stats()
+    yield
+    monkeypatch.setattr(hash512, "_BROKEN", False)
+    hash512.reset_stats()
+
+
+def _host_digests(msgs):
+    return np.stack(
+        [
+            np.frombuffer(hashlib.sha512(m).digest(), dtype=np.uint8)
+            for m in msgs
+        ]
+    )
+
+
+# --- raw SHA-512 parity -----------------------------------------------------
+
+
+@pytest.mark.parametrize("length", BOUNDARY_LENGTHS)
+def test_sha512_device_boundary_length_parity(length):
+    rng = np.random.default_rng(1000 + length)
+    msgs = [rng.integers(0, 256, size=length, dtype=np.uint8).tobytes() for _ in range(5)]
+    got = hash512.sha512_device(msgs)
+    assert got.shape == (5, 64) and got.dtype == np.uint8
+    np.testing.assert_array_equal(got, _host_digests(msgs))
+
+
+def test_sha512_device_matrix_input():
+    rng = np.random.default_rng(7)
+    mat = rng.integers(0, 256, size=(9, 73), dtype=np.uint8)
+    got = hash512.sha512_device(mat)
+    np.testing.assert_array_equal(
+        got, _host_digests([r.tobytes() for r in mat])
+    )
+
+
+def test_sha512_device_empty_batch():
+    assert hash512.sha512_device([]).shape == (0, 64)
+
+
+# --- fused challenge (prefix || msg, mod L) parity --------------------------
+
+
+def _challenge_case(n, msg_len, seed):
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, 256, size=(n, 64), dtype=np.uint8)
+    msgs = [
+        rng.integers(0, 256, size=msg_len, dtype=np.uint8).tobytes()
+        for _ in range(n)
+    ]
+    return prefix, msgs
+
+
+@pytest.mark.parametrize("length", BOUNDARY_LENGTHS)
+def test_challenge_device_boundary_parity(length):
+    """sr25519/ed25519-style prefixed challenge: SHA-512(R||A||M) mod L
+    on device must equal the hashlib + host Barrett reduction."""
+    prefix, msgs = _challenge_case(6, length, 2000 + length)
+    out = hash512.try_challenge_device(prefix, msgs)
+    assert out is not None, "uniform bounded batch must take the device path"
+    want = reduce_mod_l(sha512_batch_prefixed(prefix, msgs))
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+def test_challenge_counts_device_lanes():
+    prefix, msgs = _challenge_case(11, 32, 3)
+    assert hash512.try_challenge_device(prefix, msgs) is not None
+    assert hash512.stats()["device_lanes"] == 11
+
+
+def test_challenge_k_helper_parity_and_stage_times():
+    """The engine-side _challenge_k wrapper returns host bytes equal to
+    the host path and records the hash/pack split for bench."""
+    prefix, msgs = _challenge_case(8, 40, 4)
+    st = {}
+    got = ed25519_batch._challenge_k(prefix, msgs, None, stage_times=st)
+    want = reduce_mod_l(sha512_batch_prefixed(prefix, msgs))
+    np.testing.assert_array_equal(got, want)
+    assert st["hash_device"] is True and st["hash_ms"] >= 0.0
+
+
+# --- fallback ladder --------------------------------------------------------
+
+
+def test_mixed_lengths_fall_back_to_host():
+    prefix, msgs = _challenge_case(4, 32, 5)
+    msgs[2] = msgs[2] + b"x"  # one ragged lane
+    assert hash512.try_challenge_device(prefix, msgs) is None
+
+
+def test_oversize_lanes_fall_back(monkeypatch):
+    monkeypatch.setenv("TENDERMINT_TPU_DEVICE_HASH_MAXLEN", "16")
+    prefix, msgs = _challenge_case(4, 17, 6)
+    assert hash512.try_challenge_device(prefix, msgs) is None
+
+
+def test_env_off_disables(monkeypatch):
+    monkeypatch.setenv("TENDERMINT_TPU_DEVICE_HASH", "off")
+    prefix, msgs = _challenge_case(4, 32, 8)
+    assert hash512.try_challenge_device(prefix, msgs) is None
+
+
+def test_kernel_failure_is_sticky_and_warns():
+    def boom(backend):
+        raise RuntimeError("injected compile failure")
+
+    prefix, msgs = _challenge_case(4, 32, 9)
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(hash512, "_compiled_challenge", boom)
+        with pytest.warns(UserWarning, match="falls back"):
+            assert hash512.try_challenge_device(prefix, msgs) is None
+        assert hash512.stats()["broken"] is True
+    # Sticky: even with the kernel healthy again the process stays host.
+    assert hash512.try_challenge_device(prefix, msgs) is None
+
+
+def test_verify_batch_parity_with_device_hash():
+    """End-to-end: verify_batch verdicts are identical with the fused
+    hasher on, bad lane included."""
+    from tendermint_tpu.crypto import ed25519_ref as ref
+
+    pks, msgs, sigs = [], [], []
+    for i in range(8):
+        sk, pk = ref.keypair_from_seed(bytes([i + 40]) * 32)
+        m = b"device-hash lane %03d" % i  # uniform length -> device path
+        pks.append(pk)
+        msgs.append(m)
+        sigs.append(ref.sign(sk, m))
+    sigs[5] = bytes(64)
+    oks = ed25519_batch.verify_batch(pks, msgs, sigs)
+    assert not oks[5] and sum(oks) == 7
+    assert hash512.stats()["device_lanes"] >= 8
+
+
+# --- slow battery -----------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sha512_device_random_length_battery():
+    """10k random messages across random lengths, grouped by length so
+    each group is one uniform device batch."""
+    rng = np.random.default_rng(0xDEAD)
+    lengths = rng.integers(0, 256, size=10_000)
+    groups = {}
+    for ln in lengths:
+        groups.setdefault(int(ln), 0)
+        groups[int(ln)] += 1
+    for ln, count in sorted(groups.items()):
+        msgs = [
+            rng.integers(0, 256, size=ln, dtype=np.uint8).tobytes()
+            for _ in range(count)
+        ]
+        got = hash512.sha512_device(msgs)
+        np.testing.assert_array_equal(got, _host_digests(msgs))

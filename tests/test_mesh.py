@@ -287,6 +287,41 @@ def test_table_kernel_sharded_parity(ring, triples):
         precompute.tables.clear()
 
 
+def test_resident_kernel_sharded_parity(ring, triples, monkeypatch):
+    """With the device-resident store on, pinned keys ride the sharded
+    RESIDENT kernel — the store replicated across the mesh, only (N,)
+    int32 gather indices shipped per batch — verdicts exact, and the
+    second batch pays zero table H2D."""
+    from tendermint_tpu.ops import resident
+
+    monkeypatch.setenv("TENDERMINT_TPU_RESIDENT", "on")
+    resident.reset()
+    pks, msgs, sigs = (list(x) for x in triples)
+    precompute.pin_pubkeys(set(pks))
+    try:
+        sigs[9] = bytes(64)
+        oks = ed25519_batch.verify_batch(pks, msgs, sigs)
+        assert not oks[9] and sum(oks) == LANES - 1
+        assert mesh.manager.snapshot()["dispatches"] >= 1
+        resident_dispatches = [
+            e
+            for e in ring.export()["traceEvents"]
+            if e.get("name") == "dispatch_chunk"
+            and e.get("args", {}).get("kind") == "resident"
+        ]
+        assert resident_dispatches
+        s1 = resident.stats()
+        assert s1["uploads"] == 1 and s1["gathered_h2d_bytes"] == 0
+        oks = ed25519_batch.verify_batch(pks, msgs, sigs)
+        assert not oks[9] and sum(oks) == LANES - 1
+        s2 = resident.stats()
+        assert s2["h2d_bytes"] == s1["h2d_bytes"]
+        assert s2["gathered_h2d_bytes"] == 0
+    finally:
+        precompute.tables.clear()
+        resident.reset()
+
+
 # --- degradation: sick chip -> smaller mesh, never host --------------------
 
 

@@ -1,0 +1,239 @@
+"""Device-resident precompute table store (ops/resident.py).
+
+The perf contract under test: a validator set's tables ship to the
+device ONCE, steady-state batches carry only (N,) int32 gather indices,
+and the device copy is invalidated in lockstep with the host cache on
+rotation/eviction — a stale device tensor must never verify a
+rotated-out key. H2D accounting (``ops_table_h2d_bytes_total``) covers
+both the resident uploads and the legacy gathered-tensor path, so the
+acceptance assertion is simply: the counter is FLAT across second and
+later batches of the same committee.
+"""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import ed25519_ref as ref
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.libs.metrics import OpsMetrics, Registry
+from tendermint_tpu.ops import ed25519_batch, precompute, resident
+from tests.helpers import make_validators
+
+
+@pytest.fixture(autouse=True)
+def _resident_on(monkeypatch):
+    """Force the store on (auto keeps CPU off), isolate cache + store
+    state per test."""
+    monkeypatch.setenv("TENDERMINT_TPU_RESIDENT", "on")
+    precompute.reset()
+    resident.reset()
+    yield
+    precompute.reset()
+    resident.reset()
+
+
+def _batch(n, seed=50):
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        sk, pk = ref.keypair_from_seed(bytes([seed + i]) * 32)
+        m = b"resident lane %03d" % i
+        pks.append(pk)
+        msgs.append(m)
+        sigs.append(ref.sign(sk, m))
+    return pks, msgs, sigs
+
+
+def _h2d_total():
+    s = resident.stats()
+    return int(s["h2d_bytes"]) + int(s["gathered_h2d_bytes"])
+
+
+# --- steady state: one upload, then index-only batches ----------------------
+
+
+def test_second_batch_ships_zero_table_bytes():
+    """Acceptance: ops_table_h2d_bytes_total is flat across 2nd+
+    batches of a pinned committee, verdicts exact with a bad lane."""
+    reg = Registry()
+    ops = OpsMetrics(reg)
+    resident.bind_metrics(ops)
+    pks, msgs, sigs = _batch(16)
+    precompute.pin_pubkeys(pks)
+    sigs[3] = bytes(64)
+
+    oks = ed25519_batch.verify_batch(pks, msgs, sigs)
+    assert not oks[3] and sum(oks) == 15
+    after_first = _h2d_total()
+    metric_first = ops.table_h2d_bytes._values.get((), 0.0)
+    assert after_first > 0, "first batch must pay the table upload"
+    assert metric_first == after_first
+
+    for _ in range(2):  # 2nd and 3rd batches: zero table H2D
+        oks = ed25519_batch.verify_batch(pks, msgs, sigs)
+        assert not oks[3] and sum(oks) == 15
+    assert _h2d_total() == after_first
+    assert ops.table_h2d_bytes._values.get((), 0.0) == metric_first
+    s = resident.stats()
+    assert s["uploads"] == 1 and s["hits"] >= 32 and s["misses"] == 0
+
+
+def test_resident_hit_miss_metrics_wired():
+    reg = Registry()
+    ops = OpsMetrics(reg)
+    resident.bind_metrics(ops)
+    pks, msgs, sigs = _batch(4)
+    precompute.pin_pubkeys(pks)
+    ed25519_batch.verify_batch(pks, msgs, sigs)
+    assert ops.table_resident_hits._values.get((), 0.0) == 4
+    # Un-pinned fresh keys verify legacy: no resident lookups at all.
+    p2, m2, s2 = _batch(2, seed=90)
+    ed25519_batch.verify_batch(p2, m2, s2)
+    assert ops.table_resident_hits._values.get((), 0.0) == 4
+
+
+def test_committee_growth_refreshes_store_once():
+    """A new pinned key joining the committee triggers ONE refresh
+    upload; the grown store then serves every lane index-only."""
+    pks, msgs, sigs = _batch(6)
+    precompute.pin_pubkeys(pks[:4])
+    ed25519_batch.verify_batch(pks[:4], msgs[:4], sigs[:4])
+    assert resident.stats()["uploads"] == 1
+    precompute.pin_pubkeys(pks)  # two newcomers
+    oks = ed25519_batch.verify_batch(pks, msgs, sigs)
+    assert all(oks)
+    s = resident.stats()
+    assert s["uploads"] == 2 and s["resident_keys"] == 6
+    before = _h2d_total()
+    ed25519_batch.verify_batch(pks, msgs, sigs)
+    assert _h2d_total() == before
+
+
+# --- invalidation in lockstep with the host cache ---------------------------
+
+
+def _vset(offset, n=3):
+    return make_validators(
+        n,
+        key_factory=lambda i: Ed25519PrivKey.from_seed(
+            (200_000 * offset + i).to_bytes(32, "big")
+        ),
+    )
+
+
+def test_rotation_invalidates_device_copy():
+    """Regression: validator rotation must drop the device tensor — the
+    rotated-out keys disappear from the store and their next batch does
+    NOT ride a stale resident gather."""
+    privs, vset1 = _vset(1)
+    precompute.activate_validator_set(vset1)
+    pks = [v.pub_key.bytes() for v in vset1.validators]
+    msgs = [b"rotation msg %d" % i for i in range(len(pks))]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    assert all(ed25519_batch.verify_batch(pks, msgs, sigs))
+    assert resident.stats()["resident_keys"] == len(pks)
+
+    # Push vset1 out of the live-set window (8 deep): true rotation.
+    for off in range(2, 11):
+        _, nxt = _vset(off)
+        precompute.activate_validator_set(nxt)
+    s = resident.stats()
+    assert s["invalidations"] >= 1 and s["resident_keys"] == 0
+    # Rotated-out keys still verify correctly (host-ineligible path).
+    bad = list(sigs)
+    bad[1] = bytes(64)
+    oks = ed25519_batch.verify_batch(pks, msgs, bad)
+    assert not oks[1] and sum(oks) == len(pks) - 1
+    assert resident.stats()["resident_keys"] == 0
+
+
+def test_cache_clear_clears_store():
+    pks, msgs, sigs = _batch(4)
+    precompute.pin_pubkeys(pks)
+    ed25519_batch.verify_batch(pks, msgs, sigs)
+    assert resident.stats()["resident_keys"] == 4
+    precompute.reset()
+    assert resident.stats()["resident_keys"] == 0
+
+
+def test_lru_eviction_invalidates_device_copy(monkeypatch):
+    """An LRU eviction on the host cache must invalidate the device
+    store (the evicted column would otherwise verify stale)."""
+    monkeypatch.setenv("TENDERMINT_TPU_PRECOMPUTE_CAP", "4")
+    pks, msgs, sigs = _batch(4)
+    precompute.pin_pubkeys(pks)
+    ed25519_batch.verify_batch(pks, msgs, sigs)
+    assert resident.stats()["resident_keys"] == 4
+    inval_before = resident.stats()["invalidations"]
+    # Two more pinned keys overflow the cap: their builds evict the two
+    # LRU columns, which must drop the device tensor mid-batch (the
+    # store then re-uploads the surviving committee).
+    extra_p, extra_m, extra_s = _batch(2, seed=120)
+    precompute.pin_pubkeys(extra_p)
+    oks = ed25519_batch.verify_batch(extra_p, extra_m, extra_s)
+    assert all(oks)
+    assert resident.stats()["invalidations"] > inval_before
+    oks = ed25519_batch.verify_batch(pks + extra_p, msgs + extra_m, sigs + extra_s)
+    assert all(oks)
+
+
+# --- result-cache interaction: hits skip the gather entirely ----------------
+
+
+def test_cached_batch_skips_table_gather(monkeypatch):
+    """Regression (ISSUE 8 satellite): a repeat batch answered by the
+    digest-keyed result cache must do NO table gather and ship NO table
+    bytes — cache-hit lanes never touch the table machinery."""
+    monkeypatch.setenv("TENDERMINT_TPU_RESULT_CACHE", "1")
+    pks, msgs, sigs = _batch(8)
+    precompute.pin_pubkeys(pks)
+    assert all(ed25519_batch.verify_batch(pks, msgs, sigs))
+
+    calls = []
+    orig = precompute.tables.gather
+
+    def spy(pubkeys):
+        calls.append(len(pubkeys))
+        return orig(pubkeys)
+
+    monkeypatch.setattr(precompute.tables, "gather", spy)
+    before = _h2d_total()
+    assert all(ed25519_batch.verify_batch(pks, msgs, sigs))
+    assert calls == [], "cache-hit batch must not gather tables"
+    assert _h2d_total() == before
+
+
+# --- fallback ladder --------------------------------------------------------
+
+
+def test_off_mode_disables_acquire(monkeypatch):
+    monkeypatch.setenv("TENDERMINT_TPU_RESIDENT", "off")
+    pks, msgs, sigs = _batch(4)
+    precompute.pin_pubkeys(pks)
+    oks = ed25519_batch.verify_batch(pks, msgs, sigs)
+    assert all(oks)
+    s = resident.stats()
+    assert s["uploads"] == 0 and s["resident_keys"] == 0
+    # Gathered path still pays per-batch table bytes — and counts them.
+    assert s["gathered_h2d_bytes"] > 0
+
+
+def test_acquire_failure_never_gates_verification(monkeypatch):
+    def boom(pubkeys, has_table, plan=None, backend=None):
+        raise RuntimeError("injected store failure")
+
+    monkeypatch.setattr(resident, "acquire", boom)
+    pks, msgs, sigs = _batch(4)
+    precompute.pin_pubkeys(pks)
+    sigs[0] = bytes(64)
+    oks = ed25519_batch.verify_batch(pks, msgs, sigs)
+    assert not oks[0] and sum(oks) == 3
+
+
+def test_hot_keys_promote_to_pinned():
+    """verifyd flush notifications promote repeat offenders into the
+    pinned set so their tables go (and stay) device-resident."""
+    pks, _, _ = _batch(3, seed=150)
+    resident.note_hot_keys(pks)
+    resident.note_hot_keys(pks)  # threshold 2 -> pin
+    entries, has_table = precompute.tables.gather(pks)
+    assert entries is not None and has_table.all()
