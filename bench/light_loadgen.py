@@ -1,0 +1,217 @@
+"""Selector-based light-client load generator (PR 9 satellite).
+
+Simulates thousands of concurrent light clients against a lightd
+JSON-RPC endpoint from ONE thread: every simulated client is a
+non-blocking socket with a tiny request/response state machine
+multiplexed on a selector — the mirror image of the serving side's
+event loop, so client count is bounded by file descriptors, not
+threads.
+
+Each client works through its own pre-drawn height sequence over a
+keep-alive connection, issuing ``light_header`` calls back-to-back and
+recording per-request latency. ``zipf_heights`` draws the warm-phase
+sequences: rank-skewed toward the chain tip, the shape of real light
+clients chasing recent headers.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import random
+import selectors
+import socket
+import time
+from typing import Callable, List, Optional, Sequence
+
+
+def zipf_heights(
+    rng: random.Random,
+    heights: Sequence[int],
+    n: int,
+    exponent: float = 1.1,
+) -> List[int]:
+    """n Zipf-distributed draws over `heights`, most-popular-first by
+    DESCENDING height (the tip is rank 1)."""
+    ranked = sorted(heights, reverse=True)
+    cum: List[float] = []
+    total = 0.0
+    for rank in range(1, len(ranked) + 1):
+        total += 1.0 / (rank ** exponent)
+        cum.append(total)
+    return [
+        ranked[bisect.bisect_left(cum, rng.random() * total)]
+        for _ in range(n)
+    ]
+
+
+class _Client:
+    """One simulated light client: request out, response in, repeat."""
+
+    __slots__ = ("sock", "heights", "pos", "out", "buf", "t_send",
+                 "latencies", "errors", "want", "head_done", "awaiting")
+
+    def __init__(self, sock: socket.socket, heights: List[int]):
+        self.sock = sock
+        self.heights = heights
+        self.pos = 0
+        self.out = b""
+        self.buf = bytearray()
+        self.t_send = 0.0
+        self.latencies: List[float] = []
+        self.errors = 0
+        self.want = -1  # body bytes still expected; -1 = headers pending
+        self.head_done = 0  # offset of the end of the current header block
+        self.awaiting = False  # a response is still in flight
+
+    def done(self) -> bool:
+        return (
+            self.pos >= len(self.heights)
+            and not self.out
+            and not self.awaiting
+        )
+
+    def next_request(self) -> None:
+        body = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "id": self.pos,
+                "method": "light_header",
+                "params": {"height": self.heights[self.pos]},
+            }
+        ).encode()
+        self.pos += 1
+        self.awaiting = True
+        self.out = (
+            b"POST / HTTP/1.1\r\nHost: bench\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+        )
+        self.t_send = time.perf_counter()
+
+    def feed(self, data: bytes) -> int:
+        """Consume response bytes; returns completed responses."""
+        self.buf += data
+        completed = 0
+        while True:
+            if self.want < 0:
+                end = self.buf.find(b"\r\n\r\n")
+                if end < 0:
+                    return completed
+                head = bytes(self.buf[:end]).decode("latin-1")
+                self.head_done = end + 4
+                clen = 0
+                for line in head.split("\r\n")[1:]:
+                    k, _, v = line.partition(":")
+                    if k.strip().lower() == "content-length":
+                        clen = int(v.strip())
+                self.want = clen
+            if len(self.buf) < self.head_done + self.want:
+                return completed
+            body = bytes(self.buf[self.head_done:self.head_done + self.want])
+            del self.buf[: self.head_done + self.want]
+            self.want = -1
+            self.awaiting = False
+            self.latencies.append(time.perf_counter() - self.t_send)
+            try:
+                if "error" in json.loads(body):
+                    self.errors += 1
+            except ValueError:
+                self.errors += 1
+            completed += 1
+            return completed
+
+
+def run_load(
+    host: str,
+    port: int,
+    sequences: List[List[int]],
+    beat: Optional[Callable[[str], None]] = None,
+    timeout: float = 120.0,
+    connect_burst: int = 256,
+) -> dict:
+    """Drive one request sequence per simulated client concurrently.
+
+    Returns wall seconds, completed/error counts, and the pooled
+    latency list (seconds). Raises RuntimeError if the deadline passes
+    with clients still outstanding.
+    """
+    sel = selectors.DefaultSelector()
+    clients: List[_Client] = []
+    pending = [seq for seq in sequences if seq]
+    deadline = time.monotonic() + timeout
+    total_done = 0
+    last_beat = 0
+    try:
+        while pending or any(not c.done() for c in clients):
+            # Ramp connections in bursts so thousands of connects don't
+            # all hit the accept queue in one stampede.
+            burst = 0
+            while pending and burst < connect_burst:
+                seq = pending.pop()
+                sock = socket.socket()
+                sock.setblocking(False)
+                sock.connect_ex((host, port))
+                c = _Client(sock, seq)
+                c.next_request()
+                clients.append(c)
+                sel.register(sock, selectors.EVENT_WRITE, c)
+                burst += 1
+            for key, events in sel.select(timeout=1.0):
+                c: _Client = key.data
+                try:
+                    if events & selectors.EVENT_WRITE and c.out:
+                        sent = c.sock.send(c.out)
+                        c.out = c.out[sent:]
+                        if not c.out:
+                            sel.modify(c.sock, selectors.EVENT_READ, c)
+                    if events & selectors.EVENT_READ:
+                        data = c.sock.recv(65536)
+                        if not data:
+                            raise ConnectionError("server closed")
+                        if c.feed(data):
+                            total_done += 1
+                            if c.pos < len(c.heights):
+                                c.next_request()
+                                sel.modify(
+                                    c.sock, selectors.EVENT_WRITE, c
+                                )
+                            else:
+                                sel.unregister(c.sock)
+                                c.sock.close()
+                except (OSError, ConnectionError):
+                    c.errors += 1
+                    c.pos = len(c.heights)
+                    c.out = b""
+                    c.awaiting = False
+                    try:
+                        sel.unregister(c.sock)
+                    except (KeyError, ValueError):
+                        pass
+                    c.sock.close()
+            if beat is not None and total_done - last_beat >= 500:
+                beat("loadgen %d requests done" % total_done)
+                last_beat = total_done
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "loadgen deadline: %d requests completed" % total_done
+                )
+    finally:
+        for c in clients:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+        sel.close()
+    lat: List[float] = []
+    errors = 0
+    for c in clients:
+        lat.extend(c.latencies)
+        errors += c.errors
+    lat.sort()
+    return {
+        "clients": len(clients),
+        "completed": len(lat),
+        "errors": errors,
+        "latencies": lat,
+    }

@@ -454,6 +454,127 @@ def run_verifyd(beat) -> dict:
         srv.stop()
 
 
+def run_light_serve(beat) -> dict:
+    """PR 9 serving-tier benchmark: an in-process lightd (selector event
+    loop + verified-header cache) under BENCH_LIGHT_SERVE_CLIENTS
+    concurrent simulated light clients.
+
+    Cold phase: one ascending sweep over the chain — every height is a
+    cache miss paying a real skipping verification (one scheduler
+    super-batch per bisection round). Warm phase: the selector load
+    generator (bench/light_loadgen.py) replays Zipf-distributed heights
+    over the now-populated cache. The headline is the warm/cold
+    headers/s ratio (acceptance: >= 20x) plus warm p50/p99 and the
+    cache hit rate."""
+    import json
+    import random
+    import urllib.request
+
+    from bench.light_loadgen import run_load, zipf_heights
+    from bench.workload import build_light_block_chain
+    from tendermint_tpu.encoding.canonical import Timestamp
+    from tendermint_tpu.libs.metrics import (
+        EvloopMetrics,
+        LightMetrics,
+        Registry,
+    )
+    from tendermint_tpu.light.client import LightClient, TrustOptions
+    from tendermint_tpu.light.lightd import LightServer
+    from tendermint_tpu.light.provider import MemoryProvider
+
+    n_clients = env_int("BENCH_LIGHT_SERVE_CLIENTS", 1000)
+    n_heights = env_int("BENCH_LIGHT_SERVE_HEIGHTS", 64)
+    n_vals = env_int("BENCH_LIGHT_SERVE_VALS", 8)
+    n_requests = env_int("BENCH_LIGHT_SERVE_REQUESTS", 5000)
+
+    beat("chain fixture heights=%d vals=%d" % (n_heights, n_vals))
+    blocks, chain_id = build_light_block_chain(n_heights, n_vals)
+    now = lambda: Timestamp.from_unix_ns(  # noqa: E731
+        1_700_000_000_000_000_000 + (n_heights + 60) * 1_000_000_000
+    )
+    client = LightClient(
+        chain_id,
+        TrustOptions(period=86400.0, height=1, hash=blocks[0].hash()),
+        MemoryProvider(chain_id, blocks),
+        [],
+        now=now,
+    )
+    reg = Registry()
+    metrics = LightMetrics(reg)
+    srv = LightServer(
+        client, metrics=metrics, registry=reg,
+        evloop_metrics=EvloopMetrics(reg),
+    )
+    srv.start()
+    host, port = srv.address
+    try:
+        def rpc(method, params):
+            req = json.dumps(
+                {"jsonrpc": "2.0", "id": 1, "method": method,
+                 "params": params}
+            ).encode()
+            with urllib.request.urlopen(
+                urllib.request.Request(
+                    srv.url, data=req,
+                    headers={"Content-Type": "application/json"},
+                ),
+                timeout=60,
+            ) as resp:
+                return json.loads(resp.read())
+
+        beat("warmup (first verification compiles)")
+        out = rpc("light_header", {"height": 2})
+        assert "result" in out, out
+
+        beat("cold sweep heights=3..%d" % n_heights)
+        t0 = time.perf_counter()
+        for h in range(3, n_heights + 1):
+            out = rpc("light_header", {"height": h})
+            assert "result" in out, out
+            if h % 16 == 0:
+                beat("cold sweep at height %d" % h)
+        cold_s = time.perf_counter() - t0
+        cold_rate = (n_heights - 2) / cold_s if cold_s > 0 else 0.0
+
+        beat("warm loadgen clients=%d requests=%d" % (n_clients, n_requests))
+        rng = random.Random(4242)
+        per_client = max(1, n_requests // n_clients)
+        sequences = [
+            zipf_heights(rng, range(1, n_heights + 1), per_client)
+            for _ in range(n_clients)
+        ]
+        t0 = time.perf_counter()
+        load = run_load(host, port, sequences, beat=beat)
+        warm_s = time.perf_counter() - t0
+        lat = load["latencies"]
+        warm_rate = load["completed"] / warm_s if warm_s > 0 else 0.0
+        stats = srv.cache.stats()
+        return {
+            "light_serve": {
+                "clients": load["clients"],
+                "heights": n_heights,
+                "vals": n_vals,
+                "cold_headers_per_s": round(cold_rate, 2),
+                "warm_headers_per_s": round(warm_rate, 1),
+                "warm_vs_cold_x": round(warm_rate / cold_rate, 1)
+                if cold_rate > 0
+                else None,
+                "warm_requests": load["completed"],
+                "errors": load["errors"],
+                "warm_p50_ms": round(lat[len(lat) // 2] * 1e3, 3)
+                if lat
+                else None,
+                "warm_p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 3)
+                if lat
+                else None,
+                "cache_hit_rate": round(stats["hit_rate"], 4),
+                "cache_entries": stats["entries"],
+            }
+        }
+    finally:
+        srv.stop()
+
+
 def run_multichip(beat) -> dict:
     """Lane-axis sharded verification scaling curve (parallel/sharding):
     ROADMAP item 1's scaling axis, measured as its own section so a sick
@@ -649,6 +770,16 @@ _ALL = (
             ("BENCH_VERIFYD_ROUNDS", 8, 2),
         ),
         skip_env=("BENCH_SKIP_VERIFYD",),
+    ),
+    Section(
+        "light_serve",
+        run_light_serve,
+        degrade=(
+            ("BENCH_LIGHT_SERVE_CLIENTS", 1000, 100),
+            ("BENCH_LIGHT_SERVE_HEIGHTS", 64, 16),
+            ("BENCH_LIGHT_SERVE_REQUESTS", 5000, 500),
+        ),
+        skip_env=("BENCH_SKIP_LIGHT_SERVE",),
     ),
     Section(
         "multichip",

@@ -65,6 +65,19 @@ def mixed_key_factory(i: int):
     return Sr25519PrivKey.from_secret(b"bench-sr" + i.to_bytes(4, "big"))
 
 
+def build_light_block_chain(n_heights, n_vals):
+    """LightBlock chain over build_header_chain (constant valset) — the
+    fixture the light_serve section feeds a MemoryProvider."""
+    from tendermint_tpu.types import LightBlock
+
+    chain, vset, chain_id = build_header_chain(n_heights, n_vals)
+    blocks = [
+        LightBlock(signed_header=sh, validator_set=vset.copy())
+        for sh in chain
+    ]
+    return blocks, chain_id
+
+
 def build_header_chain(n_heights, n_vals):
     """Signed-header chain with a constant validator set (the shape of
     light/client_benchmark_test.go's fixture)."""

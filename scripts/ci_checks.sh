@@ -140,6 +140,43 @@ for mul in vpu mxu; do
     }
 done
 
+echo "== lightd serving tier (evloop suites + light_serve smoke) =="
+# PR 9 stage: the selector event loop must keep both wire protocols
+# byte-identical (grpc + verifyd regression suites and the evloop
+# regressions proper), and a 200-client light_serve smoke on CPU must
+# land status=ok with a nonzero warm-phase cache hit rate.
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_grpc.py tests/test_verifyd.py \
+    tests/test_evloop.py tests/test_lightd.py -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly || rc_total=1
+rm -rf /tmp/_bench_light && mkdir -p /tmp/_bench_light
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    BENCH_SECTIONS=light_serve BENCH_LIGHT_SERVE_CLIENTS=200 \
+    BENCH_LIGHT_SERVE_HEIGHTS=24 BENCH_LIGHT_SERVE_REQUESTS=1000 \
+    BENCH_SECTION_TIMEOUT=360 BENCH_SECTION_ATTEMPTS=1 \
+    BENCH_PARTIAL=/tmp/_bench_light/partial.json \
+    python bench.py > /tmp/_bench_light/out.json 2>/tmp/_bench_light/err.log
+if [ "$?" -ne 0 ]; then
+    echo "bench light_serve smoke: non-zero rc" >&2
+    tail -5 /tmp/_bench_light/err.log >&2
+    rc_total=1
+fi
+python - <<'EOF' || rc_total=1
+import json
+merged = json.load(open("/tmp/_bench_light/out.json"))
+assert merged["sections"]["light_serve"]["status"] == "ok", merged["sections"]
+ls = merged["light_serve"]
+assert ls["errors"] == 0, ls
+assert ls["cache_hit_rate"] > 0, ls
+assert ls["warm_headers_per_s"] > 0, ls
+print(
+    "bench light_serve smoke ok: %s clients, %.0f headers/s warm, "
+    "hit rate %.2f" % (ls["clients"], ls["warm_headers_per_s"],
+                       ls["cache_hit_rate"])
+)
+EOF
+
 echo "== tier-1 pytest =="
 set -o pipefail
 rm -f /tmp/_t1.log

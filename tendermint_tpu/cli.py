@@ -513,7 +513,11 @@ def cmd_verifyd(args) -> int:
     resident accelerator serving batched signature verification to many
     nodes/light clients. ``--metrics HOST:PORT`` additionally serves the
     Prometheus registry (and /debug/traces) over HTTP."""
-    from tendermint_tpu.libs.metrics import Registry, VerifydMetrics
+    from tendermint_tpu.libs.metrics import (
+        EvloopMetrics,
+        Registry,
+        VerifydMetrics,
+    )
     from tendermint_tpu.parallel import mesh
     from tendermint_tpu.verifyd.server import VerifydServer
 
@@ -532,6 +536,7 @@ def cmd_verifyd(args) -> int:
         admission_cap=args.admission_cap,
         max_pending=args.max_pending,
         metrics=VerifydMetrics(reg),
+        evloop_metrics=EvloopMetrics(reg),
     )
     metrics_server = None
     if args.metrics:
@@ -561,6 +566,71 @@ def cmd_verifyd(args) -> int:
     finally:
         if metrics_server is not None:
             metrics_server.stop()
+        server.stop()
+    return 0
+
+
+def cmd_lightd(args) -> int:
+    """Run the light-client serving tier (light/lightd.py): a LightClient
+    with a verified-header cache behind the selector event loop, serving
+    ``light_header``/``light_status`` to many concurrent light clients.
+    The Prometheus registry (cache traffic, serve latency, event-loop
+    connections) is exposed on the same listener at GET /metrics."""
+    from tendermint_tpu.libs.metrics import (
+        EvloopMetrics,
+        LightMetrics,
+        Registry,
+    )
+    from tendermint_tpu.light.client import LightClient, TrustOptions
+    from tendermint_tpu.light.lightd import LightServer
+    from tendermint_tpu.light.provider import HTTPProvider, RetryingProvider
+
+    if args.trace:
+        from tendermint_tpu.libs import tracing
+
+        tracing.configure(args.trace)
+    reg = Registry()
+    light_metrics = LightMetrics(reg)
+    primary = RetryingProvider(HTTPProvider(args.chain_id, args.primary))
+    witnesses = [
+        RetryingProvider(HTTPProvider(args.chain_id, w))
+        for w in (args.witness or [])
+    ]
+    client = LightClient(
+        chain_id=args.chain_id,
+        trust_options=TrustOptions(
+            period=args.trust_period,
+            height=args.trust_height,
+            hash=bytes.fromhex(args.trust_hash),
+        ),
+        primary=primary,
+        witnesses=witnesses,
+        metrics=light_metrics,
+    )
+    host, _, port = args.listen.rpartition(":")
+    server = LightServer(
+        client,
+        host=host or "127.0.0.1",
+        port=int(port or 0),
+        cache_capacity=args.cache_capacity,
+        metrics=light_metrics,
+        registry=reg,
+        evloop_metrics=EvloopMetrics(reg),
+        workers=args.workers,
+    )
+    stop = []
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    server.start()
+    print(
+        f"lightd for {args.chain_id} on {server.url} "
+        f"(cache_capacity={args.cache_capacity})",
+        flush=True,
+    )
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
         server.stop()
     return 0
 
@@ -1021,6 +1091,33 @@ def build_parser() -> argparse.ArgumentParser:
         help="span tracing: off | ring | <chrome-trace path>",
     )
     p.set_defaults(fn=cmd_verifyd)
+
+    p = sub.add_parser(
+        "lightd", help="run the light-client serving tier"
+    )
+    p.add_argument("primary", help="primary full node RPC url")
+    p.add_argument("--chain-id", required=True)
+    p.add_argument("--trust-height", type=int, required=True)
+    p.add_argument("--trust-hash", required=True, help="hex header hash")
+    p.add_argument("--trust-period", type=float, default=14 * 86400.0)
+    p.add_argument("--witness", action="append", default=[])
+    p.add_argument(
+        "--listen", default="127.0.0.1:26671", metavar="HOST:PORT",
+        help="JSON-RPC listen address (also serves /metrics)",
+    )
+    p.add_argument(
+        "--cache-capacity", type=int, default=10_000,
+        help="verified-header cache size (LRU entries)",
+    )
+    p.add_argument(
+        "--workers", type=int, default=None,
+        help="event-loop worker threads (default: evloop default)",
+    )
+    p.add_argument(
+        "--trace", default="",
+        help="span tracing: off | ring | <chrome-trace path>",
+    )
+    p.set_defaults(fn=cmd_lightd)
 
     p = sub.add_parser(
         "debug", help="collect diagnostics from a running node"

@@ -213,6 +213,58 @@ class VerifyScheduler:
             self._wake.notify_all()
         return entry
 
+    def submit_many(
+        self,
+        lanes: Sequence[Tuple[bytes, bytes, bytes]],
+        *,
+        priority: int = 0,
+        flush_by: Optional[float] = None,
+        tag: Optional[object] = None,
+    ) -> List[_Pending]:
+        """Atomically enqueue a whole lane group under ONE lock round and
+        ONE accumulator wake-up. This is the super-batch entry point for
+        callers that assemble many signatures at once (the light client's
+        bisection ladder): all-or-nothing against ``max_pending``, so a
+        half-admitted group can never split across two flushes on the
+        admission boundary. Pair with ``flush_by=time.monotonic()`` to
+        pull the flush immediately and spend exactly one device call on
+        the group."""
+        now = time.monotonic()
+        entries = [
+            _Pending(pk, msg, sig, now, priority=priority,
+                     flush_by=flush_by, tag=tag)
+            for pk, msg, sig in lanes
+        ]
+        with self._wake:
+            if self._stop or self._thread is None:
+                raise RuntimeError("scheduler not running")
+            if self.max_pending and (
+                len(self._pending) + len(entries) > self.max_pending
+            ):
+                self.submit_rejections += 1
+                raise SchedulerSaturatedError(
+                    f"verify queue full ({self.max_pending} pending)"
+                )
+            self._pending.extend(entries)
+            self._wake.notify_all()
+        return entries
+
+    def wait_many(
+        self, entries: Sequence[_Pending], timeout: float = 10.0
+    ) -> List[bool]:
+        """Block until every entry's batch flushed; per-entry verdicts,
+        fail-closed on timeout (same contract as ``wait``). The deadline
+        is shared across the group, not per entry."""
+        deadline = time.monotonic() + timeout
+        out: List[bool] = []
+        for e in entries:
+            left = deadline - time.monotonic()
+            if left <= 0 or not e.done.wait(timeout=left):
+                out.append(False)
+            else:
+                out.append(e.ok)
+        return out
+
     def pending_depth(self) -> int:
         """Entries accumulated but not yet handed to a flush."""
         with self._mtx:

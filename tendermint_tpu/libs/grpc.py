@@ -40,6 +40,8 @@ documented here rather than half-built.
 
 from __future__ import annotations
 
+import collections
+import os
 import socket
 import struct
 import threading
@@ -607,24 +609,362 @@ class _RequestNotSent(Exception):
 
 Handler = Callable[[bytes], bytes]
 
+# Per-dispatch connection identity: set around every handler call (both
+# serving modes), so handlers that account per-connection (verifyd's
+# cross-client flush counter) don't have to assume thread-per-connection.
+_conn_tag = threading.local()
+
+
+def current_conn_tag(default=None):
+    """The connection identity of the request currently being handled
+    on this thread, or ``default`` outside a dispatch."""
+    return getattr(_conn_tag, "tag", default)
+
+
+def evloop_enabled() -> bool:
+    """Selector-based serving is the default; TENDERMINT_TPU_EVLOOP=off
+    restores the historical thread-per-connection accept loops."""
+    return os.environ.get("TENDERMINT_TPU_EVLOOP", "on").lower() not in (
+        "off", "0", "false", "threaded",
+    )
+
+
+class _QuietClose(Exception):
+    """Close the connection without logging (wrong client preface)."""
+
+
+def _frame_bytes(ftype: int, flags: int, stream_id: int, payload: bytes) -> bytes:
+    return (
+        len(payload).to_bytes(3, "big")
+        + bytes([ftype, flags])
+        + (stream_id & 0x7FFFFFFF).to_bytes(4, "big")
+        + payload
+    )
+
+
+class _H2ServerConn:
+    """Sans-IO server half of one HTTP/2 connection.
+
+    ``feed()`` consumes raw bytes (any chunking) and drives preface
+    validation, HPACK, stream assembly, and connection-level frames;
+    completed requests go to ``dispatch(sid, headers, body)`` and every
+    byte out goes through ``send(bytes)``. Response DATA honors both
+    send windows — what the windows can't take queues per stream and
+    drains when the peer grants credit (WINDOW_UPDATE / SETTINGS), so
+    no driver thread ever blocks on flow control.
+
+    One machine serves two drivers: the blocking per-socket driver
+    (``GrpcServer._serve_conn``, dispatch inline on the reading thread)
+    and the selector-loop driver (``_H2Protocol``, dispatch deferred to
+    the worker pool). ``_mtx`` is reentrant so the inline driver can
+    respond from within ``feed`` while worker responses stay safe
+    against a concurrently-feeding loop thread."""
+
+    def __init__(self, server: "GrpcServer", send: Callable[[bytes], None],
+                 dispatch: Optional[Callable[[int, Dict[str, str], bytes], None]] = None):
+        self._server = server
+        self._send = send
+        self._dispatch = dispatch or (
+            lambda sid, hdrs, body: server._dispatch(self, sid, hdrs, body)
+        )
+        self.decoder = HpackDecoder()
+        self._mtx = threading.RLock()
+        self._buf = bytearray()  # guarded-by: _mtx
+        self._preface_ok = False  # guarded-by: _mtx
+        self.send_window = DEFAULT_WINDOW  # guarded-by: _mtx
+        self.peer_initial_window = DEFAULT_WINDOW  # guarded-by: _mtx
+        self.stream_send: Dict[int, int] = {}  # guarded-by: _mtx
+        # per-stream pending output: ["headers", hdrs, end] /
+        # ["data", bytes, offset, end] items awaiting window credit
+        self._outq: Dict[int, collections.deque] = {}  # guarded-by: _mtx
+        self._finished: set = set()  # guarded-by: _mtx
+        # stream_id -> [header_list or None, data bytearray, ended]
+        self._streams: Dict[int, list] = {}  # guarded-by: _mtx
+        self._header_block = bytearray()  # guarded-by: _mtx
+        self._block_stream = 0  # guarded-by: _mtx
+
+    # --- inbound -------------------------------------------------------------
+
+    def feed(self, data: bytes) -> None:
+        with self._mtx:
+            self._buf += data
+            if not self._preface_ok:
+                if len(self._buf) < len(PREFACE):
+                    return
+                if bytes(self._buf[: len(PREFACE)]) != PREFACE:
+                    raise _QuietClose()
+                del self._buf[: len(PREFACE)]
+                self._preface_ok = True
+                self._send(
+                    _frame_bytes(FRAME_SETTINGS, 0, 0, _settings_payload())
+                    + _frame_bytes(
+                        FRAME_WINDOW_UPDATE, 0, 0,
+                        (BIG_WINDOW - DEFAULT_WINDOW).to_bytes(4, "big"),
+                    )
+                )
+            while True:
+                if len(self._buf) < 9:
+                    return
+                length = int.from_bytes(self._buf[:3], "big")
+                if len(self._buf) < 9 + length:
+                    return
+                ftype, flags = self._buf[3], self._buf[4]
+                sid = int.from_bytes(self._buf[5:9], "big") & 0x7FFFFFFF
+                payload = bytes(self._buf[9 : 9 + length])
+                del self._buf[: 9 + length]
+                self._on_frame_locked(ftype, flags, sid, payload)
+
+    def _apply_settings_locked(self, payload: bytes) -> None:
+        for off in range(0, len(payload) - 5, 6):
+            ident, value = struct.unpack_from("!HI", payload, off)
+            if ident == SETTINGS_INITIAL_WINDOW_SIZE:
+                # RFC 9113 6.9.2: delta applies to all open streams.
+                delta = value - self.peer_initial_window
+                self.peer_initial_window = value
+                for sid in self.stream_send:
+                    self.stream_send[sid] += delta
+
+    def _on_frame_locked(
+        self, ftype: int, flags: int, sid: int, frame: bytes
+    ) -> None:
+        if ftype == FRAME_WINDOW_UPDATE:
+            inc = int.from_bytes(frame, "big") & 0x7FFFFFFF
+            if sid == 0:
+                self.send_window += inc
+            elif sid in self.stream_send:
+                self.stream_send[sid] += inc
+            self._drain_all_locked()
+            return
+        if ftype == FRAME_SETTINGS:
+            if not flags & FLAG_ACK:
+                self._apply_settings_locked(frame)
+                self._send(_frame_bytes(FRAME_SETTINGS, FLAG_ACK, 0, b""))
+                self._drain_all_locked()
+            return
+        if ftype == FRAME_PING:
+            if not flags & FLAG_ACK:
+                self._send(_frame_bytes(FRAME_PING, FLAG_ACK, 0, frame))
+            return
+        if ftype == FRAME_GOAWAY:
+            raise H2ProtocolError("peer sent GOAWAY")
+        if ftype == FRAME_PRIORITY:
+            return
+        if ftype in (FRAME_HEADERS, FRAME_CONTINUATION):
+            if ftype == FRAME_HEADERS:
+                if self._block_stream != 0:
+                    # RFC 7540 §4.3: a header block must not be
+                    # interleaved with frames of any other kind or
+                    # stream.
+                    raise H2ProtocolError("HEADERS while a header block is open")
+                frame = _strip_padding(flags, frame)
+                if flags & FLAG_PRIORITY:
+                    frame = frame[5:]
+                self._block_stream = sid
+                if len(self._streams) >= MAX_STREAMS_PER_CONN:
+                    raise H2ProtocolError("too many in-flight streams")
+                self._streams[sid] = [None, bytearray(), False]
+                self.stream_send[sid] = self.peer_initial_window
+            else:  # CONTINUATION
+                if self._block_stream == 0:
+                    raise H2ProtocolError(
+                        "CONTINUATION without a preceding HEADERS"
+                    )
+                if sid != self._block_stream:
+                    raise H2ProtocolError("CONTINUATION on the wrong stream")
+            self._header_block += frame
+            if len(self._header_block) > MAX_HEADER_BLOCK:
+                raise H2ProtocolError("header block too large")
+            if flags & FLAG_END_HEADERS:
+                # Decode even if the stream was reset meanwhile: skipping
+                # would desync the HPACK dynamic table for every later
+                # stream on this connection.
+                decoded = self.decoder.decode(bytes(self._header_block))
+                if self._block_stream in self._streams:
+                    self._streams[self._block_stream][0] = decoded
+                self._header_block.clear()
+                self._block_stream = 0
+            if flags & FLAG_END_STREAM and sid in self._streams:
+                self._streams[sid][2] = True
+        elif ftype == FRAME_DATA and sid in self._streams:
+            frame = _strip_padding(flags, frame)
+            st = self._streams[sid]
+            st[1] += frame
+            if len(st[1]) > MAX_MESSAGE:
+                raise H2ProtocolError("gRPC message exceeds 64MB cap")
+            if frame:
+                # replenish the connection-level receive window
+                self._send(
+                    _frame_bytes(
+                        FRAME_WINDOW_UPDATE, 0, 0,
+                        len(frame).to_bytes(4, "big"),
+                    )
+                )
+            if flags & FLAG_END_STREAM:
+                st[2] = True
+        elif ftype == FRAME_RST_STREAM and sid in self._streams:
+            del self._streams[sid]
+            self.stream_send.pop(sid, None)
+            self._outq.pop(sid, None)
+            self._finished.discard(sid)
+        # dispatch complete streams
+        done = [
+            s for s, st in self._streams.items()
+            if st[2] and st[0] is not None
+        ]
+        for s in done:
+            hdrs, body, _ = self._streams.pop(s)
+            self._dispatch(s, dict(hdrs), bytes(body))
+
+    # --- outbound ------------------------------------------------------------
+
+    def send_headers(
+        self, stream_id: int, headers: List[Tuple[str, str]], end_stream: bool
+    ) -> None:
+        with self._mtx:
+            q = self._outq.get(stream_id)
+            if q:
+                # data is stalled on window credit ahead of us: keep the
+                # frame order by queueing behind it
+                q.append(["headers", headers, end_stream])
+                return
+            self._send_headers_now(stream_id, headers, end_stream)
+
+    def _send_headers_now(
+        self, stream_id: int, headers: List[Tuple[str, str]], end_stream: bool
+    ) -> None:
+        flags = FLAG_END_HEADERS | (FLAG_END_STREAM if end_stream else 0)
+        self._send(
+            _frame_bytes(FRAME_HEADERS, flags, stream_id, hpack_encode(headers))
+        )
+
+    def send_data(self, stream_id: int, data: bytes, end_stream: bool) -> None:
+        with self._mtx:
+            q = self._outq.setdefault(stream_id, collections.deque())
+            q.append(["data", data, 0, end_stream])
+            self._drain_stream_locked(stream_id)
+
+    def finish_stream(self, stream_id: int) -> None:
+        """The response is fully queued: reclaim window bookkeeping once
+        (and only once) the stream's queue drains."""
+        with self._mtx:
+            self._finished.add(stream_id)
+            self._drain_stream_locked(stream_id)
+
+    def _drain_all_locked(self) -> None:
+        for sid in list(self._outq):
+            self._drain_stream_locked(sid)
+
+    def _drain_stream_locked(self, sid: int) -> None:
+        q = self._outq.get(sid)
+        while q:
+            item = q[0]
+            if item[0] == "headers":
+                self._send_headers_now(sid, item[1], item[2])
+                q.popleft()
+                continue
+            _, data, off, end = item
+            total = len(data)
+            if total == 0:
+                self._send(
+                    _frame_bytes(
+                        FRAME_DATA, FLAG_END_STREAM if end else 0, sid, b""
+                    )
+                )
+                q.popleft()
+                continue
+            stalled = False
+            while off < total:
+                stream_w = self.stream_send.get(sid, self.peer_initial_window)
+                avail = min(self.send_window, stream_w)
+                if avail <= 0:
+                    item[2] = off
+                    stalled = True
+                    break
+                n = min(MAX_FRAME, total - off, avail)
+                self.send_window -= n
+                if sid in self.stream_send:
+                    self.stream_send[sid] -= n
+                last = off + n >= total
+                self._send(
+                    _frame_bytes(
+                        FRAME_DATA,
+                        FLAG_END_STREAM if (end and last) else 0,
+                        sid,
+                        data[off : off + n],
+                    )
+                )
+                off += n
+            if stalled:
+                return
+            q.popleft()
+        if sid in self._outq and not self._outq[sid]:
+            del self._outq[sid]
+        if sid in self._finished and sid not in self._outq:
+            self._finished.discard(sid)
+            self.stream_send.pop(sid, None)
+
+
+class _H2Protocol:
+    """libs/evloop adapter: loop bytes feed the sans-IO machine; each
+    completed request dispatches on the server's worker pool, responding
+    through the transport's buffered writes."""
+
+    def __init__(self, server: "GrpcServer", transport):
+        self._server = server
+        self._t = transport
+        self._mc = _H2ServerConn(server, transport.write, self._defer_dispatch)
+
+    def _defer_dispatch(self, sid: int, headers: Dict[str, str], body: bytes) -> None:
+        self._t.defer(lambda: self._run(sid, headers, body))
+
+    def _run(self, sid: int, headers: Dict[str, str], body: bytes) -> None:
+        try:
+            self._server._dispatch(self._mc, sid, headers, body)
+        except Exception:
+            # response could not even be queued — tear the connection
+            # (the peer sees a reset; other connections keep serving)
+            self._t.abort()
+
+    def data_received(self, data: bytes) -> None:
+        self._mc.feed(data)  # raises on protocol error; the loop closes us
+
+    def eof_received(self) -> None:
+        pass  # loop drops the connection after this
+
+    def connection_lost(self, exc) -> None:
+        pass
+
 
 class GrpcServer:
-    """Threaded unary gRPC server: one thread per connection, handlers
-    dispatched by :path. Handler exceptions become grpc-status INTERNAL;
-    unknown paths UNIMPLEMENTED (grpc_server.go:83 shape)."""
+    """Unary gRPC server, handlers dispatched by :path. Handler
+    exceptions become grpc-status INTERNAL; unknown paths UNIMPLEMENTED
+    (grpc_server.go:83 shape).
+
+    Serving modes: the default runs every connection on one selector
+    event loop (libs/evloop) with a bounded worker pool for handlers —
+    thread count is O(workers), not O(connections). Setting
+    TENDERMINT_TPU_EVLOOP=off (or ``evloop=False``) restores the
+    historical thread-per-connection accept loop. Both modes drive the
+    same sans-IO connection machine, so the wire behavior is identical
+    byte for byte."""
 
     def __init__(self, handlers: Dict[str, Handler], host: str = "127.0.0.1",
-                 port: int = 0, logger=None):
+                 port: int = 0, logger=None, evloop: Optional[bool] = None,
+                 evloop_metrics=None, workers: Optional[int] = None):
         self._handlers = handlers
         self._logger = logger if logger is not None else log.NOP_LOGGER
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._evloop_enabled = evloop_enabled() if evloop is None else evloop
+        self._evloop_metrics = evloop_metrics
+        self._workers = workers
+        self._ev = None
         # Bind eagerly (SocketServer does the same) so `address` is
         # valid before start() and a busy port fails at construction.
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind((host, port))
-        s.listen(16)
+        s.listen(128)
         self._lsock: Optional[socket.socket] = s
 
     @property
@@ -634,12 +974,32 @@ class GrpcServer:
 
     def start(self) -> None:
         self._stop.clear()
+        if self._evloop_enabled:
+            from tendermint_tpu.libs import evloop as evloop_mod
+
+            kwargs = {}
+            if self._evloop_metrics is not None:
+                kwargs["metrics"] = self._evloop_metrics
+            if self._workers is not None:
+                kwargs["workers"] = self._workers
+            self._ev = evloop_mod.EvloopServer(
+                lambda t: _H2Protocol(self, t),
+                listener_ref=lambda: self._lsock,
+                name="grpc",
+                logger=self._logger,
+                **kwargs,
+            )
+            self._ev.start()
+            return
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
 
     def stop(self) -> None:
         self._stop.set()
+        if self._ev is not None:
+            self._ev.stop()
+            self._ev = None
         if self._lsock is not None:
             try:
                 self._lsock.close()
@@ -680,82 +1040,14 @@ class GrpcServer:
             # that vanished without FIN.
             sock.settimeout(None)
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
-            if _read_exact(sock, len(PREFACE)) != PREFACE:
-                return
-            write_frame(sock, FRAME_SETTINGS, 0, 0, _settings_payload())
-            write_frame(
-                sock, FRAME_WINDOW_UPDATE, 0, 0,
-                (BIG_WINDOW - DEFAULT_WINDOW).to_bytes(4, "big"),
-            )
-            conn = _ConnState(sock)
-            # stream_id -> [header_list or None, data bytearray, ended]
-            streams: Dict[int, list] = {}
-            header_block = bytearray()
-            block_stream = 0
+            machine = _H2ServerConn(self, sock.sendall)
             while not self._stop.is_set():
-                ftype, flags, sid, frame = conn.next_stream_frame()
-                if ftype in (FRAME_HEADERS, FRAME_CONTINUATION):
-                    if ftype == FRAME_HEADERS:
-                        if block_stream != 0:
-                            # RFC 7540 §4.3: a header block must not be
-                            # interleaved with frames of any other kind
-                            # or stream.
-                            raise H2ProtocolError(
-                                "HEADERS while a header block is open"
-                            )
-                        frame = _strip_padding(flags, frame)
-                        if flags & FLAG_PRIORITY:
-                            frame = frame[5:]
-                        block_stream = sid
-                        if len(streams) >= MAX_STREAMS_PER_CONN:
-                            raise H2ProtocolError("too many in-flight streams")
-                        streams[sid] = [None, bytearray(), False]
-                        conn.open_stream(sid)
-                    else:  # CONTINUATION
-                        if block_stream == 0:
-                            raise H2ProtocolError(
-                                "CONTINUATION without a preceding HEADERS"
-                            )
-                        if sid != block_stream:
-                            raise H2ProtocolError(
-                                "CONTINUATION on the wrong stream"
-                            )
-                    header_block += frame
-                    if len(header_block) > MAX_HEADER_BLOCK:
-                        raise H2ProtocolError("header block too large")
-                    if flags & FLAG_END_HEADERS:
-                        # Decode even if the stream was reset meanwhile:
-                        # skipping would desync the HPACK dynamic table
-                        # for every later stream on this connection.
-                        decoded = conn.decoder.decode(bytes(header_block))
-                        if block_stream in streams:
-                            streams[block_stream][0] = decoded
-                        header_block.clear()
-                        block_stream = 0
-                    if flags & FLAG_END_STREAM and sid in streams:
-                        streams[sid][2] = True
-                elif ftype == FRAME_DATA and sid in streams:
-                    frame = _strip_padding(flags, frame)
-                    streams[sid][1] += frame
-                    if len(streams[sid][1]) > MAX_MESSAGE:
-                        raise H2ProtocolError("gRPC message exceeds 64MB cap")
-                    conn.replenish(len(frame))
-                    if flags & FLAG_END_STREAM:
-                        streams[sid][2] = True
-                elif ftype == FRAME_RST_STREAM and sid in streams:
-                    del streams[sid]
-                    conn.close_stream(sid)
-                # dispatch complete streams
-                done = [
-                    s for s, st in streams.items()
-                    if st[2] and st[0] is not None
-                ]
-                for s in done:
-                    hdrs, body, _ = streams.pop(s)
-                    try:
-                        self._dispatch(conn, s, dict(hdrs), bytes(body))
-                    finally:
-                        conn.close_stream(s)
+                data = sock.recv(65536)
+                if not data:
+                    raise H2ProtocolError("connection closed mid-frame")
+                machine.feed(data)
+        except _QuietClose:
+            pass  # wrong client preface: close silently, nothing to log
         except (H2ProtocolError, OSError, GrpcError) as exc:
             # A misbehaving or vanished peer ends its own connection
             # thread; the server and every other connection keep serving.
@@ -779,40 +1071,47 @@ class GrpcServer:
                 pass  # best-effort close of an already-dead socket
 
     def _dispatch(
-        self, conn: _ConnState, stream_id: int, headers: Dict[str, str],
+        self, conn: "_H2ServerConn", stream_id: int, headers: Dict[str, str],
         body: bytes,
     ) -> None:
         path = headers.get(":path", "")
         handler = self._handlers.get(path)
         resp_headers = [(":status", "200"), ("content-type", "application/grpc")]
-        if handler is None:
-            conn.send_headers(stream_id, resp_headers, end_stream=False)
-            conn.send_headers(
-                stream_id,
-                [("grpc-status", str(GRPC_UNIMPLEMENTED)),
-                 ("grpc-message", f"unknown method {path}")],
-                end_stream=True,
-            )
-            return
         try:
-            result = handler(grpc_unframe(body))
-            conn.send_headers(stream_id, resp_headers, end_stream=False)
-            conn.send_data(stream_id, grpc_frame(result), end_stream=False)
-            conn.send_headers(
-                stream_id, [("grpc-status", "0")], end_stream=True
-            )
-        except GrpcError as e:
-            conn.send_headers(stream_id, resp_headers, end_stream=False)
-            conn.send_headers(
-                stream_id,
-                [("grpc-status", str(e.status)), ("grpc-message", e.message)],
-                end_stream=True,
-            )
-        except Exception as e:  # handler bug -> INTERNAL, connection survives
-            conn.send_headers(stream_id, resp_headers, end_stream=False)
-            conn.send_headers(
-                stream_id,
-                [("grpc-status", str(GRPC_INTERNAL)),
-                 ("grpc-message", f"{type(e).__name__}: {e}")],
-                end_stream=True,
-            )
+            if handler is None:
+                conn.send_headers(stream_id, resp_headers, end_stream=False)
+                conn.send_headers(
+                    stream_id,
+                    [("grpc-status", str(GRPC_UNIMPLEMENTED)),
+                     ("grpc-message", f"unknown method {path}")],
+                    end_stream=True,
+                )
+                return
+            try:
+                _conn_tag.tag = id(conn)
+                try:
+                    result = handler(grpc_unframe(body))
+                finally:
+                    _conn_tag.tag = None
+                conn.send_headers(stream_id, resp_headers, end_stream=False)
+                conn.send_data(stream_id, grpc_frame(result), end_stream=False)
+                conn.send_headers(
+                    stream_id, [("grpc-status", "0")], end_stream=True
+                )
+            except GrpcError as e:
+                conn.send_headers(stream_id, resp_headers, end_stream=False)
+                conn.send_headers(
+                    stream_id,
+                    [("grpc-status", str(e.status)), ("grpc-message", e.message)],
+                    end_stream=True,
+                )
+            except Exception as e:  # handler bug -> INTERNAL, connection survives
+                conn.send_headers(stream_id, resp_headers, end_stream=False)
+                conn.send_headers(
+                    stream_id,
+                    [("grpc-status", str(GRPC_INTERNAL)),
+                     ("grpc-message", f"{type(e).__name__}: {e}")],
+                    end_stream=True,
+                )
+        finally:
+            conn.finish_stream(stream_id)

@@ -571,6 +571,54 @@ class VerifydMetrics(_NopMixin):
         )
 
 
+class EvloopMetrics(_NopMixin):
+    """The shared selector event loop (libs/evloop.py): connection
+    gauge per server so operators can see 10k sockets multiplexing onto
+    one loop thread. No reference analog — the reference is
+    thread-per-connection."""
+
+    def __init__(self, reg: Optional[Registry]):
+        reg = reg or Registry()
+        s = "evloop"
+        self.connections = reg.gauge(
+            _name(s, "connections"),
+            "Open connections multiplexed on the event loop, per server.",
+            labels=("server",),
+        )
+
+
+class LightMetrics(_NopMixin):
+    """The light-client serving tier (light/cache.py, lightd): verified-
+    header cache traffic, bisection depth, and end-to-end serve latency.
+    No metrics.gen.go analog; the shape follows the PR 9 serving SLOs."""
+
+    def __init__(self, reg: Optional[Registry]):
+        reg = reg or Registry()
+        s = "light"
+        self.cache_hits = reg.counter(
+            _name(s, "cache_hits_total"),
+            "Verified-header cache hits.",
+        )
+        self.cache_misses = reg.counter(
+            _name(s, "cache_misses_total"),
+            "Verified-header cache misses.",
+        )
+        self.cache_evictions = reg.counter(
+            _name(s, "cache_evictions_total"),
+            "Verified-header cache entries evicted (LRU or invalidation).",
+        )
+        self.bisection_rounds = reg.histogram(
+            _name(s, "bisection_rounds"),
+            "Scheduler super-batch rounds per skipping verification.",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32),
+        )
+        self.serve_latency_seconds = reg.histogram(
+            _name(s, "serve_latency_seconds"),
+            "End-to-end light_header serve latency, seconds.",
+            labels=("outcome",),
+        )
+
+
 class StateMetrics(_NopMixin):
     """internal/state/metrics.gen.go."""
 

@@ -153,6 +153,12 @@ class _SanitizedLock:
     def locked(self) -> bool:
         return self._inner.locked()
 
+    def _at_fork_reinit(self) -> None:
+        # stdlib modules (concurrent.futures.thread) register this with
+        # os.register_at_fork at import time; held-state bookkeeping in
+        # the child is stale anyway, so just reinit the raw lock.
+        self._inner._at_fork_reinit()
+
     def __enter__(self) -> bool:
         return self.acquire()
 

@@ -16,6 +16,9 @@ from dataclasses import dataclass, field as dc_field
 from typing import Callable, List, Optional
 
 from tendermint_tpu.encoding.canonical import Timestamp
+from tendermint_tpu.libs import tracing
+from tendermint_tpu.libs.metrics import LightMetrics
+from tendermint_tpu.light import batch as light_batch
 from tendermint_tpu.light import verifier
 from tendermint_tpu.light.provider import (
     HeightTooHighError,
@@ -76,6 +79,8 @@ class LightClient:
         sequential: bool = False,
         pruning_size: int = DEFAULT_PRUNING_SIZE,
         now: Optional[Callable[[], Timestamp]] = None,
+        bisect_batching: Optional[bool] = None,
+        metrics: Optional[LightMetrics] = None,
     ):
         trust_options.validate()
         verifier.validate_trust_level(trust_level)
@@ -88,6 +93,14 @@ class LightClient:
         self.store = store or LightStore()
         self.sequential = sequential
         self.pruning_size = pruning_size
+        # one-super-batch-per-round bisection (light/batch.py); None
+        # defers to the TENDERMINT_TPU_LIGHT_BATCH env gate
+        self.bisect_batching = (
+            light_batch.batching_enabled()
+            if bisect_batching is None
+            else bisect_batching
+        )
+        self.metrics = metrics or LightMetrics.nop()
         self._now = now or (lambda: Timestamp.from_unix_ns(_time.time_ns()))
         self._initialize(trust_options)
 
@@ -198,7 +211,93 @@ class LightClient:
     ) -> None:
         """client.go verifySkipping:647: bisection. Trust the target if
         trustLevel of the current trusted valset signed it; otherwise
-        bisect towards the trusted block."""
+        bisect towards the trusted block. Batched by default: the whole
+        pivot ladder of a round rides one scheduler super-batch
+        (light/batch.py) instead of one device call per pivot."""
+        if self.bisect_batching:
+            return self._verify_skipping_batched(trusted, new_block, now)
+        return self._verify_skipping_sequential(trusted, new_block, now)
+
+    def _verify_skipping_batched(
+        self, trusted: LightBlock, new_block: LightBlock, now: Timestamp
+    ) -> None:
+        """Same accept/reject decisions as the sequential loop, proved
+        by the parity suite: each round plans the full descending pivot
+        ladder [target, mid, mid-of-mid, ...] down to base+1, verifies
+        every candidate in ONE super-batch, then accepts the first
+        (shallowest) candidate that verifies — exactly the candidate the
+        sequential descent would have accepted. Hard errors surface at
+        the first candidate the sequential walk would have visited;
+        verdicts of deeper candidates are ignored past that point."""
+        pivots = {}  # height -> prefetched pivot, reused across rounds
+        trace_base = trusted
+        current = new_block
+        rounds = 0
+        try:
+            while True:
+                base = trace_base
+                candidates = [current]
+                # the exception owed if evaluation descends off the ladder:
+                # a pivot fetch/validate failure, or "cannot split further"
+                ladder_stop: Optional[Exception] = None
+                while ladder_stop is None:
+                    pivot_height = (base.height + candidates[-1].height) // 2
+                    if pivot_height in (base.height, candidates[-1].height):
+                        ladder_stop = LightClientError(
+                            "bisection failed: cannot split further"
+                        )
+                        break
+                    pivot = pivots.get(pivot_height)
+                    if pivot is None:
+                        try:
+                            pivot = self._fetch_from_primary(pivot_height)
+                            pivot.validate_basic(self.chain_id)
+                        except Exception as exc:
+                            ladder_stop = exc
+                            break
+                        pivots[pivot_height] = pivot
+                    candidates.append(pivot)
+                rounds += 1
+                with tracing.span(
+                    "light_round",
+                    round=rounds,
+                    base=base.height,
+                    target=current.height,
+                    candidates=len(candidates),
+                ):
+                    outcomes = light_batch.evaluate_candidates(
+                        self.chain_id,
+                        base,
+                        candidates,
+                        self.trusting_period,
+                        now,
+                        self.max_clock_drift,
+                        self.trust_level,
+                    )
+                accepted = None
+                for cand, out in zip(candidates, outcomes):
+                    if out.kind == light_batch.OK:
+                        accepted = cand
+                        break
+                    if out.kind == light_batch.BISECT:
+                        continue
+                    raise out.error
+                if accepted is None:
+                    # every candidate needs a deeper pivot and there is none
+                    raise ladder_stop
+                if accepted.height == new_block.height:
+                    return
+                trace_base = accepted
+                self.store.save_light_block(accepted)
+                current = new_block
+        finally:
+            self.metrics.bisection_rounds.observe(rounds)
+
+    def _verify_skipping_sequential(
+        self, trusted: LightBlock, new_block: LightBlock, now: Timestamp
+    ) -> None:
+        """The reference's one-call-per-pivot loop, kept verbatim as the
+        parity baseline (TENDERMINT_TPU_LIGHT_BATCH=off)."""
         verification_trace = [trusted]
         current = new_block
         while True:
@@ -251,7 +350,13 @@ class LightClient:
         bad witness and gets dropped (detector.go examineConflictingHeader)."""
         if not self.witnesses:
             return
+        trusted = self.store.light_block_before(new_block.height)
+        # Gather every conflicting witness header first, then verify all
+        # of them against the trusted root in ONE scheduler super-batch
+        # (batched mode) — a round of witness cross-checks costs one
+        # device call, not one per witness.
         bad_witnesses = []
+        conflicts = []  # (witness index, witness, block, basic_ok)
         for i, witness in enumerate(list(self.witnesses)):
             try:
                 w_block = witness.light_block(new_block.height)
@@ -262,23 +367,50 @@ class LightClient:
             # Verify the witness trace against the trusted root before
             # treating the conflict as evidence; garbage from a faulty
             # witness must not DoS the client or spawn bogus evidence.
-            trusted = self.store.light_block_before(new_block.height)
             try:
                 w_block.validate_basic(self.chain_id)
-                if trusted is not None:
-                    verifier.verify(
-                        trusted.signed_header,
-                        trusted.validator_set,
-                        w_block.signed_header,
-                        w_block.validator_set,
-                        self.trusting_period,
-                        now,
-                        self.max_clock_drift,
-                        self.trust_level,
-                    )
             except (ValueError, verifier.InvalidHeaderError):
+                conflicts.append((i, witness, w_block, False))
+                continue
+            conflicts.append((i, witness, w_block, True))
+        outcomes = {}
+        to_verify = [
+            c for c in conflicts if c[3] and trusted is not None
+        ]
+        if to_verify:
+            if self.bisect_batching:
+                evaluated = light_batch.evaluate_candidates(
+                    self.chain_id,
+                    trusted,
+                    [c[2] for c in to_verify],
+                    self.trusting_period,
+                    now,
+                    self.max_clock_drift,
+                    self.trust_level,
+                )
+            else:
+                evaluated = [
+                    light_batch._resolve_sequential(
+                        self.chain_id, trusted, c[2], self.trusting_period,
+                        now, self.max_clock_drift, self.trust_level,
+                    )
+                    for c in to_verify
+                ]
+            for c, out in zip(to_verify, evaluated):
+                outcomes[c[0]] = out
+        for i, witness, w_block, basic_ok in conflicts:
+            out = outcomes.get(i)
+            if not basic_ok:
                 bad_witnesses.append(witness)
                 continue
+            if out is not None and out.kind != light_batch.OK:
+                err = out.error
+                if isinstance(err, (ValueError, verifier.InvalidHeaderError)):
+                    # includes NewValSetCantBeTrusted: an unverifiable
+                    # witness is just a bad witness, not an attack
+                    bad_witnesses.append(witness)
+                    continue
+                raise err  # e.g. NotEnoughVotingPowerError, raw as before
             # Conflict verified on both sides: a real light-client attack
             # (detector.go:122-215 abridged: common height = latest trusted
             # below the conflict).

@@ -8,6 +8,8 @@ split of the reference); an RPC-backed provider plugs in the same ABC.
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import Dict, List, Optional
 
 from tendermint_tpu.types.evidence import Evidence
@@ -75,6 +77,100 @@ class MemoryProvider(Provider):
     def report_evidence(self, evidence: Evidence) -> None:
         with self._lock:
             self.evidence.append(evidence)
+
+
+class ProviderBudgetExhaustedError(ProviderError):
+    """The wrapped provider burned its failure budget; fail fast until
+    the rolling window slides past the old failures."""
+
+
+class RetryingProvider(Provider):
+    """Transient-failure armor for any Provider (lightd serving tier).
+
+    Retries ONLY transient ``ProviderError``s (network flaps, bad
+    responses) with exponential backoff. Definitive answers —
+    ``LightBlockNotFoundError`` and ``HeightTooHighError`` — are part of
+    the protocol and propagate immediately; retrying them would only
+    stall bisection.
+
+    A rolling per-provider failure budget turns a persistently sick
+    provider into an immediate ``ProviderBudgetExhaustedError`` instead
+    of a retry storm: once `failure_budget` transient failures land
+    within `budget_window` seconds, calls fail fast until the window
+    slides. `sleep` and `clock` are injectable so tests run in zero
+    wall-clock time.
+    """
+
+    def __init__(self, inner: Provider, retries: int = 3,
+                 base_delay: float = 0.05, max_delay: float = 2.0,
+                 failure_budget: int = 8, budget_window: float = 60.0,
+                 sleep=time.sleep, clock=time.monotonic):
+        if retries < 0:
+            raise ValueError("retries must be >= 0")
+        self.inner = inner
+        self.retries = retries
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.failure_budget = failure_budget
+        self.budget_window = budget_window
+        self._sleep = sleep
+        self._clock = clock
+        self._mtx = threading.Lock()
+        # Timestamps (clock()) of recent transient failures.
+        self._failures: deque = deque()  # guarded-by: _mtx
+        self.retries_total = 0  # guarded-by: _mtx
+        self.fast_fails_total = 0  # guarded-by: _mtx
+
+    def chain_id(self) -> str:
+        return self.inner.chain_id()
+
+    def _budget_left_locked(self) -> int:
+        horizon = self._clock() - self.budget_window
+        while self._failures and self._failures[0] < horizon:
+            self._failures.popleft()
+        return self.failure_budget - len(self._failures)
+
+    def _note_failure(self) -> None:
+        with self._mtx:
+            self._failures.append(self._clock())
+
+    def _check_budget(self) -> None:
+        with self._mtx:
+            if self._budget_left_locked() <= 0:
+                self.fast_fails_total += 1
+                raise ProviderBudgetExhaustedError(
+                    f"provider failure budget exhausted "
+                    f"({self.failure_budget} transient failures in "
+                    f"{self.budget_window:g}s)"
+                )
+
+    def light_block(self, height: int) -> LightBlock:
+        self._check_budget()
+        delay = self.base_delay
+        last: Optional[ProviderError] = None
+        for attempt in range(self.retries + 1):
+            try:
+                return self.inner.light_block(height)
+            except (LightBlockNotFoundError, HeightTooHighError):
+                raise  # definitive protocol answers, never transient
+            except ProviderError as e:
+                self._note_failure()
+                last = e
+                with self._mtx:
+                    out_of_budget = self._budget_left_locked() <= 0
+                if out_of_budget or attempt == self.retries:
+                    break
+                with self._mtx:
+                    self.retries_total += 1
+                self._sleep(delay)
+                delay = min(delay * 2.0, self.max_delay)
+        assert last is not None
+        raise last
+
+    def report_evidence(self, evidence: Evidence) -> None:
+        # Evidence broadcast is best-effort fire-and-forget upstream;
+        # no retry loop (HTTPProvider already swallows failures).
+        self.inner.report_evidence(evidence)
 
 
 class HTTPProvider(Provider):

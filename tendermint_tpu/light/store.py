@@ -61,6 +61,16 @@ class LightStore:
             return LightBlock.from_proto_bytes(v)
         return None
 
+    def heights(self) -> list:
+        """All stored heights, ascending. lightd uses the before/after
+        delta of this to memoize which pivots proved a verification."""
+        return [
+            int.from_bytes(k[1:9], "big")
+            for k, _ in self._db.iterator(
+                _lb_key(0), prefix_end(bytes([PREFIX_LIGHT_BLOCK]))
+            )
+        ]
+
     def prune(self, size: int) -> None:
         """Keep only the newest `size` blocks (db.go Prune)."""
         heights = [

@@ -2,11 +2,21 @@
 
 The reference serves ~35 routes over HTTP POST (JSON-RPC envelope), GET
 (URI params), and websocket (rpc/jsonrpc/server/). This server covers
-the POST/GET surface with Python's threading HTTP server and replaces
-the websocket stream with the reference's own newer alternative: the
-``/events`` long-poll endpoint backed by the sliding-window eventlog
-(internal/eventlog/eventlog.go:25, internal/rpc/core/events.go:103) —
-same data, no custom framing protocol.
+the POST/GET surface and replaces the websocket stream with the
+reference's own newer alternative: the ``/events`` long-poll endpoint
+backed by the sliding-window eventlog (internal/eventlog/eventlog.go:25,
+internal/rpc/core/events.go:103) — same data, no custom framing
+protocol.
+
+Serving modes: the default multiplexes every connection on one
+selector event loop (libs/evloop) with a bounded worker pool for the
+route handlers, so the light-client serving tier can hold 10k+ idle
+keep-alive sockets without 10k threads. ``TENDERMINT_TPU_EVLOOP=off``
+(or ``evloop=False``) restores the historical ``ThreadingHTTPServer``.
+Both modes answer through the same dispatch/encoding core, so the HTTP
+surface is identical. Websocket upgrades detach from the loop onto a
+dedicated thread (long-lived, rarely-used sessions — the same trade
+the reference makes for its ws handlers).
 
 Handlers come from an rpc.core.Environment-bound route table; params
 arrive as JSON object/array (POST) or query strings (GET).
@@ -18,6 +28,7 @@ import json
 import socket
 import threading
 import traceback
+from email.utils import formatdate
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qsl, urlparse
@@ -39,9 +50,195 @@ METHOD_NOT_FOUND = -32601
 INVALID_PARAMS = -32602
 INTERNAL_ERROR = -32603
 
+# sentinel returned by _get_response for GET /websocket: the driver owns
+# the upgrade (it needs the raw connection, not a body)
+_WS_UPGRADE = object()
+
+_STATUS_PHRASES = {
+    200: "OK",
+    400: "Bad Request",
+    501: "Not Implemented",
+}
+_MAX_HEADER_BYTES = 64 * 1024
+_MAX_BODY_BYTES = 64 << 20
+
+
+class _HttpProtocol:
+    """libs/evloop connection state machine for the HTTP/1.1 surface.
+
+    The loop thread feeds raw bytes; a minimal parser assembles one
+    request at a time (requests on one connection are served in order —
+    same as the per-connection handler thread it replaces) and defers
+    the route handler to the worker pool, which queues the response
+    through the transport. Keep-alive is the HTTP/1.1 default;
+    ``Connection: close`` and HTTP/1.0 behave as usual."""
+
+    def __init__(self, server: "RPCServer", transport):
+        self._server = server
+        self._t = transport
+        self._mtx = threading.Lock()
+        self._buf = bytearray()  # guarded-by: _mtx
+        self._busy = False  # a request is in flight  # guarded-by: _mtx
+        self._detached = False  # guarded-by: _mtx
+
+    # --- loop-thread callbacks ----------------------------------------------
+
+    def data_received(self, data: bytes) -> None:
+        with self._mtx:
+            if self._detached:
+                return
+            self._buf += data
+        self._pump()
+
+    def eof_received(self) -> None:
+        pass  # loop drops the connection after this
+
+    def connection_lost(self, exc) -> None:
+        pass
+
+    # --- request assembly ----------------------------------------------------
+
+    def _pump(self) -> None:
+        with self._mtx:
+            if self._busy or self._detached:
+                return
+            req = self._parse_locked()
+            if req is None:
+                return
+            self._busy = True
+        self._t.defer(lambda: self._run(req))
+
+    def _parse_locked(self):
+        idx = self._buf.find(b"\r\n\r\n")
+        if idx < 0:
+            if len(self._buf) > _MAX_HEADER_BYTES:
+                raise ValueError("HTTP header block too large")
+            return None
+        head = bytes(self._buf[:idx]).decode("latin-1")
+        lines = head.split("\r\n")
+        parts = lines[0].split(" ")
+        if len(parts) != 3:
+            raise ValueError("malformed HTTP request line")
+        method, target, version = parts
+        headers: Dict[str, str] = {}
+        for ln in lines[1:]:
+            k, _, v = ln.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        try:
+            blen = int(headers.get("content-length", "0") or "0")
+        except ValueError:
+            raise ValueError("malformed Content-Length")
+        if blen > _MAX_BODY_BYTES:
+            raise ValueError("HTTP body too large")
+        total = idx + 4 + blen
+        if len(self._buf) < total:
+            return None
+        body = bytes(self._buf[idx + 4 : total])
+        del self._buf[:total]
+        return (method, target, version, headers, body)
+
+    # --- worker-side handling -------------------------------------------------
+
+    def _run(self, req) -> None:
+        method, target, version, headers, body = req
+        try:
+            conn_hdr = headers.get("connection", "").lower()
+            close = "close" in conn_hdr or (
+                version == "HTTP/1.0" and "keep-alive" not in conn_hdr
+            )
+            if method == "POST":
+                status, ctype, out = 200, "application/json", (
+                    self._server._post_body(body)
+                )
+            elif method == "GET":
+                got = self._server._get_response(target)
+                if got is _WS_UPGRADE:
+                    self._upgrade(headers)
+                    return
+                status, ctype, out = got
+            else:
+                status, ctype, out = (
+                    501, "application/json",
+                    b'{"error": "unsupported method"}',
+                )
+            self._t.write(_http_head(status, ctype, len(out), close) + out)
+            if close:
+                self._t.close()
+                return
+        except Exception:
+            # handler-layer failure with the response half-planned:
+            # drop the connection, never a half-written payload
+            self._t.abort()
+            return
+        with self._mtx:
+            self._busy = False
+        self._pump()  # serve the next pipelined request, if buffered
+
+    def _upgrade(self, headers: Dict[str, str]) -> None:
+        from tendermint_tpu.rpc import websocket as ws
+
+        shaped = {
+            "Upgrade": headers.get("upgrade", ""),
+            "Connection": headers.get("connection", ""),
+            "Sec-WebSocket-Key": headers.get("sec-websocket-key"),
+        }
+        if not ws.is_upgrade_request(shaped):
+            out = b'{"error": "websocket upgrade required"}'
+            self._t.write(
+                _http_head(400, "application/json", len(out), True) + out
+            )
+            self._t.close()
+            return
+        with self._mtx:
+            self._detached = True
+        sock = self._t.detach()  # loop hands the raw socket over
+        server = self._server
+
+        def session():
+            try:
+                sock.sendall(
+                    b"HTTP/1.1 101 Switching Protocols\r\n"
+                    b"Upgrade: websocket\r\n"
+                    b"Connection: Upgrade\r\n"
+                    b"Sec-WebSocket-Accept: "
+                    + ws.accept_key(shaped["Sec-WebSocket-Key"]).encode()
+                    + b"\r\n\r\n"
+                )
+                rfile = sock.makefile("rb")
+                wfile = sock.makefile("wb")
+                conn = ws.WSConn(rfile, wfile)
+                ws.WSSession(conn, server.routes, server.event_bus).run()
+            except OSError:
+                pass  # peer vanished mid-session
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass  # best-effort close; session is over regardless
+
+        # dedicated thread, not a pool worker: sessions live for the
+        # client's lifetime and would pin the bounded pool
+        threading.Thread(
+            target=session, name="rpc-ws-session", daemon=True
+        ).start()
+
+
+def _http_head(status: int, ctype: str, length: int, close: bool) -> bytes:
+    phrase = _STATUS_PHRASES.get(status, "OK")
+    head = (
+        f"HTTP/1.1 {status} {phrase}\r\n"
+        f"Server: {BaseHTTPRequestHandler.server_version}\r\n"
+        f"Date: {formatdate(usegmt=True)}\r\n"
+        f"Content-Type: {ctype}\r\n"
+        f"Content-Length: {length}\r\n"
+    )
+    if close:
+        head += "Connection: close\r\n"
+    return (head + "\r\n").encode("latin-1")
+
 
 class RPCServer:
-    """Threaded HTTP JSON-RPC server bound to a route table."""
+    """HTTP JSON-RPC server bound to a route table."""
 
     def __init__(
         self,
@@ -50,6 +247,9 @@ class RPCServer:
         port: int = 0,
         metrics_registry=None,
         event_bus=None,
+        evloop: Optional[bool] = None,
+        evloop_metrics=None,
+        workers: Optional[int] = None,
     ):
         self.routes = routes
         # Prometheus text exposition at GET /metrics (the reference serves
@@ -58,6 +258,22 @@ class RPCServer:
         self.metrics_registry = metrics_registry
         # event bus backing websocket subscribe/unsubscribe (routes.go:31-34)
         self.event_bus = event_bus
+        from tendermint_tpu.libs.grpc import evloop_enabled
+
+        self._evloop_enabled = evloop_enabled() if evloop is None else evloop
+        self._evloop_metrics = evloop_metrics
+        self._workers = workers
+        self._ev = None
+        self._lsock: Optional[socket.socket] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        if self._evloop_enabled:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((host, port))
+            s.listen(128)
+            self._lsock = s
+            return
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -69,33 +285,17 @@ class RPCServer:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
-                try:
-                    req = json.loads(body or b"{}")
-                except (json.JSONDecodeError, UnicodeDecodeError):
-                    self._reply(None, error=(PARSE_ERROR, "parse error", ""))
-                    return
-                if isinstance(req, list):
-                    if not req:
-                        # JSON-RPC 2.0: empty batch is a single invalid
-                        # request error, not an empty array
-                        self._reply(
-                            None,
-                            error=(INVALID_REQUEST, "empty batch", ""),
-                        )
-                        return
-                    out = [server._dispatch(r) for r in req]
-                    self._send(200, json.dumps(out).encode())
-                    return
-                self._send(200, json.dumps(server._dispatch(req)).encode())
+                self._send(200, server._post_body(body))
 
             def do_GET(self):
-                parsed = urlparse(self.path)
-                method = parsed.path.strip("/")
-                if method == "websocket":
+                got = server._get_response(self.path)
+                if got is _WS_UPGRADE:
                     from tendermint_tpu.rpc import websocket as ws
 
                     if not ws.is_upgrade_request(self.headers):
-                        self._send(400, b'{"error": "websocket upgrade required"}')
+                        self._send(
+                            400, b'{"error": "websocket upgrade required"}'
+                        )
                         return
                     key = self.headers["Sec-WebSocket-Key"]
                     self.send_response_only(101)
@@ -111,63 +311,15 @@ class RPCServer:
                     ).run()
                     self.close_connection = True
                     return
-                if method == "":
-                    self._send(200, server._index().encode())
-                    return
-                if method == "debug/traces":
-                    # Chrome-trace JSON export of the global span tracer;
-                    # bounded by the tracer's ring capacity. ?limit=N caps
-                    # the event count, ?clear=1 drains the ring after read.
-                    from tendermint_tpu.libs import tracing
-
-                    q = dict(parse_qsl(parsed.query))
-                    try:
-                        limit = int(q["limit"]) if "limit" in q else None
-                    except ValueError:
-                        limit = None
-                    clear = q.get("clear") in ("1", "true")
-                    body = json.dumps(
-                        tracing.tracer.export(limit=limit, clear=clear)
-                    ).encode()
-                    self._send(200, body)
-                    return
-                if method == "metrics" and server.metrics_registry is not None:
-                    body = server.metrics_registry.expose().encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type", "text/plain; version=0.0.4"
-                    )
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    try:
-                        self.wfile.write(body)
-                    except (BrokenPipeError, ConnectionResetError):
-                        pass  # scraper hung up mid-response; nothing to answer
-                    return
-                params: Dict[str, Any] = {}
-                for k, v in parse_qsl(parsed.query):
-                    # heuristics matching the reference's URI param
-                    # decoding: quoted strings, 0x-hex, numbers, bools
-                    if v.startswith('"') and v.endswith('"') and len(v) >= 2:
-                        params[k] = v[1:-1]
-                    elif v in ("true", "false"):
-                        params[k] = v == "true"
-                    else:
-                        try:
-                            params[k] = int(v)
-                        except ValueError:
-                            params[k] = v
-                req = {"jsonrpc": "2.0", "id": -1, "method": method, "params": params}
-                self._send(200, json.dumps(server._dispatch(req)).encode())
-
-            def _reply(self, result, error=None, id_=None):
-                resp: Dict[str, Any] = {"jsonrpc": "2.0", "id": id_}
-                if error is not None:
-                    code, msg, data = error
-                    resp["error"] = {"code": code, "message": msg, "data": data}
-                else:
-                    resp["result"] = result
-                self._send(200, json.dumps(resp).encode())
+                status, ctype, body = got
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # client hung up mid-response; nothing to answer
 
             def _send(self, status: int, body: bytes):
                 self.send_response(status)
@@ -181,11 +333,13 @@ class RPCServer:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
-        self._thread: Optional[threading.Thread] = None
 
     @property
     def address(self) -> Tuple[str, int]:
-        return self._httpd.server_address[:2]
+        if self._httpd is not None:
+            return self._httpd.server_address[:2]
+        assert self._lsock is not None
+        return self._lsock.getsockname()[:2]
 
     @property
     def url(self) -> str:
@@ -193,20 +347,115 @@ class RPCServer:
         return f"http://{host}:{port}"
 
     def start(self) -> None:
+        if self._evloop_enabled:
+            from tendermint_tpu.libs.evloop import EvloopServer
+
+            kwargs = {}
+            if self._evloop_metrics is not None:
+                kwargs["metrics"] = self._evloop_metrics
+            if self._workers is not None:
+                kwargs["workers"] = self._workers
+            self._ev = EvloopServer(
+                lambda t: _HttpProtocol(self, t),
+                listener_ref=lambda: self._lsock,
+                name="rpc",
+                **kwargs,
+            )
+            self._ev.start()
+            return
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="rpc-server"
         )
         self._thread.start()
 
     def stop(self) -> None:
-        # shutdown() blocks forever unless serve_forever is running
-        # (BaseServer.__is_shut_down is only set by the serve loop), so a
-        # never-started server gets only server_close().
-        if self._thread is not None:
-            self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
+        if self._ev is not None:
+            self._ev.stop()
+            self._ev = None
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass  # listener may already be closed; stop() is idempotent
+            self._lsock = None
+        if self._httpd is not None:
+            # shutdown() blocks forever unless serve_forever is running
+            # (BaseServer.__is_shut_down is only set by the serve loop), so
+            # a never-started server gets only server_close().
+            if self._thread is not None:
+                self._httpd.shutdown()
+            self._httpd.server_close()
+            if self._thread is not None:
+                self._thread.join(timeout=2)
+
+    # -- shared request core ---------------------------------------------------
+
+    def _post_body(self, body: bytes) -> bytes:
+        """POST surface: JSON-RPC envelope (single or batch) -> response
+        body bytes. Always HTTP 200 + application/json."""
+        try:
+            req = json.loads(body or b"{}")
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return json.dumps(
+                _error_envelope(PARSE_ERROR, "parse error")
+            ).encode()
+        if isinstance(req, list):
+            if not req:
+                # JSON-RPC 2.0: empty batch is a single invalid request
+                # error, not an empty array
+                return json.dumps(
+                    _error_envelope(INVALID_REQUEST, "empty batch")
+                ).encode()
+            return json.dumps([self._dispatch(r) for r in req]).encode()
+        return json.dumps(self._dispatch(req)).encode()
+
+    def _get_response(self, target: str):
+        """GET surface: target (path?query) -> (status, content-type,
+        body) — or the ``_WS_UPGRADE`` sentinel, which the calling
+        driver turns into a connection upgrade."""
+        parsed = urlparse(target)
+        method = parsed.path.strip("/")
+        if method == "websocket":
+            return _WS_UPGRADE
+        if method == "":
+            return 200, "application/json", self._index().encode()
+        if method == "debug/traces":
+            # Chrome-trace JSON export of the global span tracer; bounded
+            # by the tracer's ring capacity. ?limit=N caps the event
+            # count, ?clear=1 drains the ring after read.
+            from tendermint_tpu.libs import tracing
+
+            q = dict(parse_qsl(parsed.query))
+            try:
+                limit = int(q["limit"]) if "limit" in q else None
+            except ValueError:
+                limit = None
+            clear = q.get("clear") in ("1", "true")
+            body = json.dumps(
+                tracing.tracer.export(limit=limit, clear=clear)
+            ).encode()
+            return 200, "application/json", body
+        if method == "metrics" and self.metrics_registry is not None:
+            return (
+                200,
+                "text/plain; version=0.0.4",
+                self.metrics_registry.expose().encode(),
+            )
+        params: Dict[str, Any] = {}
+        for k, v in parse_qsl(parsed.query):
+            # heuristics matching the reference's URI param decoding:
+            # quoted strings, 0x-hex, numbers, bools
+            if v.startswith('"') and v.endswith('"') and len(v) >= 2:
+                params[k] = v[1:-1]
+            elif v in ("true", "false"):
+                params[k] = v == "true"
+            else:
+                try:
+                    params[k] = int(v)
+                except ValueError:
+                    params[k] = v
+        req = {"jsonrpc": "2.0", "id": -1, "method": method, "params": params}
+        return 200, "application/json", json.dumps(self._dispatch(req)).encode()
 
     # -- dispatch -------------------------------------------------------------
 
@@ -214,15 +463,9 @@ class RPCServer:
         if not isinstance(req, dict):
             # JSON-RPC: a request must be an object; a valid-JSON scalar
             # or string body is an invalid request, not a server error
-            return {
-                "jsonrpc": "2.0",
-                "id": None,
-                "error": {
-                    "code": INVALID_REQUEST,
-                    "message": "request must be a JSON object",
-                    "data": "",
-                },
-            }
+            return _error_envelope(
+                INVALID_REQUEST, "request must be a JSON object"
+            )
         id_ = req.get("id")
         resp: Dict[str, Any] = {"jsonrpc": "2.0", "id": id_}
         method = req.get("method")
@@ -258,3 +501,11 @@ class RPCServer:
         lines = ["Available endpoints:"]
         lines += sorted(f"  /{name}" for name in self.routes)
         return "\n".join(lines)
+
+
+def _error_envelope(code: int, message: str, data: str = "") -> Dict[str, Any]:
+    return {
+        "jsonrpc": "2.0",
+        "id": None,
+        "error": {"code": code, "message": message, "data": data},
+    }

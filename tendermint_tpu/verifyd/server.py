@@ -36,7 +36,7 @@ from tendermint_tpu.crypto.scheduler import (
     default_max_batch,
 )
 from tendermint_tpu.libs import tracing
-from tendermint_tpu.libs.grpc import GrpcServer
+from tendermint_tpu.libs.grpc import GrpcServer, current_conn_tag
 from tendermint_tpu.libs.metrics import VerifydMetrics
 from tendermint_tpu.verifyd import protocol
 from tendermint_tpu.verifyd.protocol import (
@@ -137,6 +137,7 @@ class VerifydServer:
         verify_fn: Optional[Callable[..., List[bool]]] = None,
         sr25519_verify_fn: Optional[Callable[..., List[bool]]] = None,
         metrics: Optional[VerifydMetrics] = None,
+        evloop_metrics=None,
     ):
         self.metrics = metrics or VerifydMetrics.nop()
         self.max_delay = max_delay
@@ -172,7 +173,10 @@ class VerifydServer:
         self.admission_rejections = 0  # guarded-by: _stats_mtx
         self.deadline_expired = 0  # guarded-by: _stats_mtx
         self.requests_served = 0  # guarded-by: _stats_mtx
-        self._grpc = GrpcServer({VERIFY_PATH: self._handle}, host, port)
+        self._grpc = GrpcServer(
+            {VERIFY_PATH: self._handle}, host, port,
+            evloop_metrics=evloop_metrics,
+        )
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -334,7 +338,11 @@ class VerifydServer:
             if deadline_s:
                 margin = max(0.001, 0.2 * deadline_s)
                 flush_by = t0 + max(0.0, deadline_s - margin)
-            tag = threading.get_ident()  # one handler thread per connection
+            # Connection identity for cross-client batching stats. Under
+            # the event loop many connections share few worker threads,
+            # so the transport's per-connection tag is authoritative;
+            # the thread ident covers direct (non-gRPC) handler calls.
+            tag = current_conn_tag(threading.get_ident())
             entries = []
             try:
                 with tracing.span(

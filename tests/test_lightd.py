@@ -1,0 +1,441 @@
+"""PR 9 serving-tier tests: batched-vs-sequential skipping parity over
+a rotating validator set, the one-super-batch-per-round pin, the
+verified-header cache (LRU + divergence invalidation), lightd serving
+semantics, provider retry/backoff, and the scheduler super-batch entry
+points."""
+
+import hashlib
+import threading
+
+import pytest
+
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.crypto.scheduler import (
+    SchedulerSaturatedError,
+    VerifyScheduler,
+)
+from tendermint_tpu.encoding.canonical import Timestamp
+from tendermint_tpu.libs import tracing
+from tendermint_tpu.libs.metrics import LightMetrics, Registry
+from tendermint_tpu.light import batch as light_batch
+from tendermint_tpu.light import (
+    DEFAULT_TRUST_LEVEL,
+    InvalidHeaderError,
+    LightClient,
+    MemoryProvider,
+    NewValSetCantBeTrustedError,
+    TrustOptions,
+)
+from tendermint_tpu.light.cache import CacheEntry, HeaderCache
+from tendermint_tpu.light.client import DivergedHeaderError
+from tendermint_tpu.light.lightd import LightServer
+from tendermint_tpu.light.provider import (
+    HeightTooHighError,
+    LightBlockNotFoundError,
+    ProviderBudgetExhaustedError,
+    ProviderError,
+    RetryingProvider,
+)
+from tendermint_tpu.rpc.server import RPCError
+from tendermint_tpu.types import (
+    BlockID,
+    Consensus,
+    Header,
+    LightBlock,
+    PartSetHeader,
+    SignedHeader,
+    Validator,
+    ValidatorSet,
+)
+from tests.helpers import CHAIN_ID, make_commit
+from tests.test_light import build_light_chain, now_at
+
+BASE_NS = 1_700_000_000_000_000_000
+HOUR = 3600.0
+
+
+def build_rotating_chain(n_heights, window=6, power=10, chain_id=CHAIN_ID):
+    """Signed-header chain whose valset slides one validator per height:
+    heights h and h+k overlap in (window-k) validators, so at trust
+    level 1/3 a skipping jump of more than window//2 steps cannot be
+    trusted and the client must bisect through REAL intermediate
+    pivots (the constant-valset fixture verifies any span in one hop)."""
+    pool = [
+        Ed25519PrivKey.from_seed((7000 + i).to_bytes(32, "big"))
+        for i in range(n_heights + window + 1)
+    ]
+    vsets, privss = [], []
+    for h in range(1, n_heights + 2):
+        keys = pool[h - 1 : h - 1 + window]
+        vset = ValidatorSet([Validator(k.pub_key(), power) for k in keys])
+        by_addr = {k.pub_key().address(): k for k in keys}
+        privss.append([by_addr[v.address] for v in vset.validators])
+        vsets.append(vset)
+    blocks = []
+    last_bid = BlockID()
+    for h in range(1, n_heights + 1):
+        vset, privs = vsets[h - 1], privss[h - 1]
+        header = Header(
+            version=Consensus(block=11),
+            chain_id=chain_id,
+            height=h,
+            time=Timestamp.from_unix_ns(BASE_NS + h * 1_000_000_000),
+            last_block_id=last_bid,
+            last_commit_hash=hashlib.sha256(b"lc%d" % h).digest(),
+            data_hash=hashlib.sha256(b"d%d" % h).digest(),
+            validators_hash=vset.hash(),
+            next_validators_hash=vsets[h].hash(),
+            consensus_hash=hashlib.sha256(b"cp").digest(),
+            app_hash=hashlib.sha256(b"app%d" % h).digest(),
+            last_results_hash=b"",
+            evidence_hash=b"",
+            proposer_address=vset.validators[0].address,
+        )
+        bid = BlockID(
+            header.hash(),
+            PartSetHeader(1, hashlib.sha256(b"parts%d" % h).digest()),
+        )
+        commit = make_commit(
+            bid, h, 0, vset, privs, chain_id=chain_id,
+            time_ns=BASE_NS + h * 1_000_000_000,
+        )
+        blocks.append(
+            LightBlock(
+                signed_header=SignedHeader(header=header, commit=commit),
+                validator_set=vset.copy(),
+            )
+        )
+        last_bid = bid
+    return blocks
+
+
+def make_client(blocks, batching, height=1, witness_blocks=None):
+    witnesses = (
+        [MemoryProvider(CHAIN_ID, witness_blocks)]
+        if witness_blocks is not None
+        else []
+    )
+    return LightClient(
+        CHAIN_ID,
+        TrustOptions(
+            period=10 * HOUR, height=height, hash=blocks[height - 1].hash()
+        ),
+        MemoryProvider(CHAIN_ID, blocks),
+        witnesses,
+        bisect_batching=batching,
+        now=now_at,
+    )
+
+
+class TestBatchParity:
+    """The batched super-batch rounds must be outcome-identical to the
+    sequential one-call-per-pivot descent."""
+
+    def test_rotating_chain_stores_identical_pivots(self):
+        blocks = build_rotating_chain(17)
+        stored = {}
+        for batching in (False, True):
+            client = make_client(blocks, batching)
+            lb = client.verify_light_block_at_height(17)
+            assert lb.height == 17
+            stored[batching] = client.store.heights()
+        # Same bisection descent -> byte-identical trust path.
+        assert stored[True] == stored[False]
+        assert len(stored[True]) > 3  # real multi-pivot bisection
+
+    def test_constant_chain_parity(self):
+        blocks, _, _ = build_light_chain(20)
+        for batching in (False, True):
+            client = make_client(blocks, batching)
+            assert client.verify_light_block_at_height(20).height == 20
+
+    def test_forged_target_commit_same_error_both_modes(self):
+        errors = {}
+        for batching in (False, True):
+            blocks = build_rotating_chain(17)
+            sh = blocks[16].signed_header
+            sh.commit.signatures[0].signature = bytes(64)
+            client = make_client(blocks, batching)
+            with pytest.raises(InvalidHeaderError) as exc:
+                client.verify_light_block_at_height(17)
+            errors[batching] = str(exc.value)
+        assert errors[True] == errors[False]
+        assert "wrong signature" in errors[True]
+
+    def test_forged_commit_below_accepted_pivot_ignored(self):
+        """The batched ladder evaluates deeper candidates than the one
+        it accepts; a forged commit BELOW the accepted pivot must not
+        poison the round (sequential descent never visits it)."""
+        blocks = build_rotating_chain(17)
+        # Ladder for base=1 target=17 descends 17,9,5,3,2; overlap math
+        # accepts 3 (first candidate within trust range). Forge height 2.
+        blocks[1].signed_header.commit.signatures[0].signature = bytes(64)
+        for batching in (False, True):
+            client = make_client(blocks, batching)
+            lb = client.verify_light_block_at_height(17)
+            assert lb.height == 17
+            assert 2 not in client.store.heights()
+
+    def test_trust_level_edge_exact_third_bisects(self):
+        """tallied == needed is NOT enough (needs strictly more): a jump
+        whose overlap lands exactly on the trust threshold must BISECT,
+        one step closer must verify."""
+        blocks = build_rotating_chain(8)
+        base = blocks[0]
+        # window=6 power=10: needed = 60//3 = 20. Height 5 overlaps in
+        # 2 validators (tallied 20), height 4 in 3 (tallied 30).
+        outcomes = light_batch.evaluate_candidates(
+            CHAIN_ID, base, [blocks[4], blocks[3]],
+            10 * HOUR, now_at(), 10.0, DEFAULT_TRUST_LEVEL,
+        )
+        assert outcomes[0].kind == light_batch.BISECT
+        assert isinstance(outcomes[0].error, NewValSetCantBeTrustedError)
+        assert outcomes[1].kind == light_batch.OK
+
+    def test_one_super_batch_per_round(self):
+        """Acceptance pin: a bisection round = at most ONE scheduler
+        super-batch (one device call), regardless of ladder width."""
+        blocks = build_rotating_chain(17)
+        client = make_client(blocks, batching=True)
+        tracing.configure("ring")
+        tracing.tracer.clear()
+        try:
+            client.verify_light_block_at_height(17)
+            events = tracing.tracer.export()["traceEvents"]
+        finally:
+            tracing.configure("off")
+        rounds = [e for e in events if e.get("name") == "light_round"]
+        batches = [e for e in events if e.get("name") == "light_super_batch"]
+        assert len(rounds) >= 2  # rotation forces real multi-round bisection
+        assert len(batches) <= len(rounds)
+        for b in batches:
+            assert b["args"]["lanes"] > 0
+
+
+class TestHeaderCache:
+    def test_lru_eviction_order(self):
+        cache = HeaderCache(capacity=2)
+        blocks, _, _ = build_light_chain(3)
+        cache.put(CHAIN_ID, blocks[0])
+        cache.put(CHAIN_ID, blocks[1])
+        assert cache.get(CHAIN_ID, 1) is not None  # refresh height 1
+        cache.put(CHAIN_ID, blocks[2])  # evicts height 2 (LRU)
+        assert cache.get(CHAIN_ID, 2) is None
+        assert cache.get(CHAIN_ID, 1) is not None
+        assert cache.get(CHAIN_ID, 3) is not None
+        assert cache.evictions == 1
+
+    def test_header_hash_pinned_get(self):
+        cache = HeaderCache()
+        blocks, _, _ = build_light_chain(2)
+        cache.put(CHAIN_ID, blocks[0])
+        assert cache.get(CHAIN_ID, 1, header_hash=blocks[0].hash())
+        assert cache.get(CHAIN_ID, 1, header_hash=b"\x01" * 32) is None
+
+    def test_invalidate_chain_scoped(self):
+        cache = HeaderCache()
+        blocks, _, _ = build_light_chain(2)
+        cache.put(CHAIN_ID, blocks[0])
+        cache.put("other-chain", blocks[1])
+        assert cache.invalidate_chain(CHAIN_ID) == 1
+        assert cache.get(CHAIN_ID, 1) is None
+        assert cache.get("other-chain", 2) is not None
+
+    def test_metrics_wired(self):
+        reg = Registry()
+        cache = HeaderCache(capacity=1, metrics=LightMetrics(reg))
+        blocks, _, _ = build_light_chain(2)
+        cache.get(CHAIN_ID, 1)  # miss
+        cache.put(CHAIN_ID, blocks[0])
+        cache.get(CHAIN_ID, 1)  # hit
+        cache.put(CHAIN_ID, blocks[1])  # evicts
+        text = reg.expose()
+        assert "tendermint_light_cache_hits_total 1" in text
+        assert "tendermint_light_cache_misses_total 1" in text
+        assert "tendermint_light_cache_evictions_total 1" in text
+
+    def test_entry_holds_memoized_proof(self):
+        blocks, _, _ = build_light_chain(2)
+        e = CacheEntry(CHAIN_ID, 1, blocks[0].hash(), blocks[0],
+                       trust_path=(1,), payload={"height": "1"})
+        assert e.trust_path == (1,) and e.payload["height"] == "1"
+
+
+class TestLightServer:
+    def make_server(self, blocks, witness_blocks=None, **kw):
+        client = make_client(blocks, batching=True,
+                             witness_blocks=witness_blocks)
+        return LightServer(client, **kw)
+
+    def test_miss_then_hit_same_payload(self):
+        blocks, _, _ = build_light_chain(10)
+        srv = self.make_server(blocks)
+        first = srv.light_header(height=10)
+        assert first["height"] == "10"
+        assert first["trust_path"]  # memoized proof rides the entry
+        assert srv.light_header(height=10) is first  # memoized dict
+        assert srv.cache.hits == 1 and srv.cache.misses == 1
+
+    def test_divergence_invalidates_cache(self):
+        blocks, _, _ = build_light_chain(10)
+        forked, _, _ = build_light_chain(10, fork_at=6)
+        srv = self.make_server(blocks, witness_blocks=forked)
+        srv.light_header(height=3)  # below the fork: witness agrees
+        assert len(srv.cache) == 1
+        with pytest.raises(RPCError) as exc:
+            srv.light_header(height=10)
+        assert "attack" in exc.value.message
+        assert len(srv.cache) == 0  # every memoized proof dropped
+
+    def test_bad_height_params(self):
+        blocks, _, _ = build_light_chain(3)
+        srv = self.make_server(blocks)
+        for bad in (None, "x", 0, -4):
+            with pytest.raises(RPCError):
+                srv.light_header(height=bad)
+
+    def test_status_reports_cache(self):
+        blocks, _, _ = build_light_chain(5)
+        srv = self.make_server(blocks)
+        srv.light_header(height=5)
+        st = srv.light_status()
+        assert st["trusted_height"] == "5"
+        assert st["cache"]["entries"] == 1
+
+    def test_single_flight_one_verification(self):
+        blocks, _, _ = build_light_chain(12)
+        client = make_client(blocks, batching=True)
+        srv = LightServer(client)
+        calls = []
+        calls_mtx = threading.Lock()
+        inner = client.verify_light_block_at_height
+
+        def counting(height, now=None):
+            with calls_mtx:
+                calls.append(height)
+            return inner(height, now)
+
+        client.verify_light_block_at_height = counting
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(srv.light_header(height=12))
+            )
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 8
+        assert all(r["height"] == "12" for r in results)
+        assert len(calls) == 1  # herd collapsed to one verification
+
+
+class FlakyProvider(MemoryProvider):
+    def __init__(self, chain_id, blocks, fail_times):
+        super().__init__(chain_id, blocks)
+        self.fail_times = fail_times
+        self.calls = 0
+
+    def light_block(self, height):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise ProviderError("transient network flap")
+        return super().light_block(height)
+
+
+class TestRetryingProvider:
+    def test_retries_transient_then_succeeds(self):
+        blocks, _, _ = build_light_chain(3)
+        slept = []
+        p = RetryingProvider(
+            FlakyProvider(CHAIN_ID, blocks, fail_times=2),
+            retries=3, base_delay=0.05, sleep=slept.append,
+        )
+        assert p.light_block(2).height == 2
+        assert slept == [0.05, 0.1]  # exponential backoff
+        assert p.retries_total == 2
+
+    def test_exhausted_retries_raise_last_error(self):
+        blocks, _, _ = build_light_chain(3)
+        p = RetryingProvider(
+            FlakyProvider(CHAIN_ID, blocks, fail_times=99),
+            retries=2, sleep=lambda s: None,
+        )
+        with pytest.raises(ProviderError, match="flap"):
+            p.light_block(2)
+
+    def test_definitive_answers_not_retried(self):
+        blocks, _, _ = build_light_chain(3)
+        inner = FlakyProvider(CHAIN_ID, blocks, fail_times=0)
+        p = RetryingProvider(inner, retries=3, sleep=lambda s: None)
+        with pytest.raises(HeightTooHighError):
+            p.light_block(50)
+        with pytest.raises(LightBlockNotFoundError):
+            RetryingProvider(
+                MemoryProvider(CHAIN_ID, []), sleep=lambda s: None
+            ).light_block(1)
+        assert inner.calls == 1  # single attempt, no retry burn
+
+    def test_failure_budget_fails_fast_then_recovers(self):
+        blocks, _, _ = build_light_chain(3)
+        clock = [0.0]
+        p = RetryingProvider(
+            FlakyProvider(CHAIN_ID, blocks, fail_times=4),
+            retries=0, failure_budget=4, budget_window=60.0,
+            sleep=lambda s: None, clock=lambda: clock[0],
+        )
+        for _ in range(4):
+            with pytest.raises(ProviderError):
+                p.light_block(2)
+        with pytest.raises(ProviderBudgetExhaustedError):
+            p.light_block(2)
+        assert p.fast_fails_total == 1
+        clock[0] = 61.0  # window slides: budget restored
+        assert p.light_block(2).height == 2
+
+
+class TestSubmitMany:
+    def make_sched(self, **kw):
+        sched = VerifyScheduler(
+            verify_fn=lambda pks, msgs, sigs: [s == b"ok" for s in sigs],
+            max_delay=0.001,
+            **kw,
+        )
+        sched.start()
+        return sched
+
+    def test_atomic_group_one_wait(self):
+        sched = self.make_sched()
+        try:
+            lanes = [
+                (b"p", b"m", b"ok"), (b"p", b"m", b"bad"), (b"p", b"m", b"ok"),
+            ]
+            entries = sched.submit_many(lanes, priority=1, tag="t")
+            assert sched.wait_many(entries, timeout=5.0) == [
+                True, False, True,
+            ]
+        finally:
+            sched.stop()
+
+    def test_all_or_nothing_on_saturation(self):
+        sched = self.make_sched(max_pending=2)
+        try:
+            with pytest.raises(SchedulerSaturatedError):
+                sched.submit_many(
+                    [(b"p", b"m", b"ok")] * 3, flush_by=None
+                )
+            # The rejected group admitted NOTHING: a full group that
+            # fits still goes through untouched.
+            entries = sched.submit_many([(b"p", b"m", b"ok")] * 2)
+            assert sched.wait_many(entries, timeout=5.0) == [True, True]
+            assert sched.submit_rejections == 1
+        finally:
+            sched.stop()
+
+    def test_submit_many_rejected_after_stop(self):
+        sched = self.make_sched()
+        sched.stop()
+        with pytest.raises(RuntimeError):
+            sched.submit_many([(b"p", b"m", b"ok")])
