@@ -454,6 +454,134 @@ def run_verifyd(beat) -> dict:
         srv.stop()
 
 
+def run_verifyd_tenants(beat) -> dict:
+    """Two-tenant mixed-load A/B: a victim tenant's consensus latency
+    while an aggressor tenant floods rpc, measured with continuous
+    batching ON vs the flush-barrier path (TENDERMINT_TPU_CONT_BATCH=off
+    equivalent). The device is MODELED (a fixed sleep per lane) so the
+    comparison isolates scheduling behavior from kernel speed — and the
+    section runs without jax."""
+    import threading
+
+    from tendermint_tpu.verifyd import protocol
+    from tendermint_tpu.verifyd.client import (
+        VerifydClient,
+        VerifydRejectedError,
+    )
+    from tendermint_tpu.verifyd.server import VerifydServer
+
+    n_rounds = env_int("BENCH_TENANTS_ROUNDS", 30)
+    n_floods = env_int("BENCH_TENANTS_FLOODS", 4)
+    lane_us = env_int("BENCH_TENANTS_LANE_US", 300)
+
+    # the modeled verifier never reads the bytes: synthetic lanes keep
+    # the section free of pure-python key arithmetic
+    victim_lanes = (
+        [b"\x01" * 32] * 4,
+        [b"victim-%d" % i for i in range(4)],
+        [b"\x02" * 64] * 4,
+    )
+    flood_lanes = (
+        [b"\x03" * 32] * 16,
+        [b"flood-%d" % i for i in range(16)],
+        [b"\x04" * 64] * 16,
+    )
+
+    def modeled(pks, msgs, sigs):
+        time.sleep(lane_us * 1e-6 * len(pks))
+        return [True] * len(pks)
+
+    def one_mode(continuous):
+        srv = VerifydServer(
+            verify_fn=modeled, max_batch=64, max_delay=0.002,
+            admission_cap=256, tenant_cap=48, continuous=continuous,
+        )
+        srv.start()
+        host, port = srv.address
+        addr = f"{host}:{port}"
+        stop = threading.Event()
+        mtx = threading.Lock()
+        flood_served = [0]
+        flood_sheds = [0]
+
+        def aggressor():
+            c = VerifydClient(
+                addr, tenant="flood", fallback=False, shed_retries=0
+            )
+            while not stop.is_set():
+                try:
+                    c.verify(*flood_lanes, klass=protocol.CLASS_RPC)
+                    with mtx:
+                        flood_served[0] += 1
+                except VerifydRejectedError:
+                    with mtx:
+                        flood_sheds[0] += 1
+                    time.sleep(0.002)  # a real client would back off
+            c.close()
+
+        lat = []
+        try:
+            victim = VerifydClient(addr, tenant="victim", fallback=False)
+            victim.verify(*victim_lanes, klass=protocol.CLASS_CONSENSUS)
+            floods = [
+                threading.Thread(target=aggressor) for _ in range(n_floods)
+            ]
+            for t in floods:
+                t.start()
+            time.sleep(0.1)  # flood established
+            for i in range(n_rounds):
+                if i % 10 == 0:
+                    beat("victim round %d/%d" % (i, n_rounds))
+                t0 = time.perf_counter()
+                oks = victim.verify(
+                    *victim_lanes, klass=protocol.CLASS_CONSENSUS
+                )
+                lat.append(time.perf_counter() - t0)
+                if not all(oks):
+                    raise AssertionError("modeled verify must pass")
+            stop.set()
+            for t in floods:
+                t.join(timeout=10)
+            victim.close()
+            tenants = {
+                label: {"lanes": s["lanes"], "sheds": s["sheds"]}
+                for label, s in srv.tenant_stats().items()
+            }
+            occupancy = srv.scheduler.dispatch_handoffs
+        finally:
+            stop.set()
+            srv.stop()
+        lat.sort()
+        return {
+            "victim_p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+            "victim_p99_ms": round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 2
+            ),
+            "flood_served": flood_served[0],
+            "flood_sheds": flood_sheds[0],
+            "dispatch_handoffs": occupancy,
+            "tenants": tenants,
+        }
+
+    beat("continuous mode rounds=%d floods=%d" % (n_rounds, n_floods))
+    cont = one_mode(True)
+    beat("barrier mode (CONT_BATCH=off)")
+    barrier = one_mode(False)
+    ratio = (
+        round(barrier["victim_p99_ms"] / cont["victim_p99_ms"], 2)
+        if cont["victim_p99_ms"]
+        else None
+    )
+    return {
+        "verifyd_tenants": {
+            "lane_us": lane_us,
+            "continuous": cont,
+            "barrier": barrier,
+            "barrier_over_continuous_p99_x": ratio,
+        }
+    }
+
+
 def run_light_serve(beat) -> dict:
     """PR 9 serving-tier benchmark: an in-process lightd (selector event
     loop + verified-header cache) under BENCH_LIGHT_SERVE_CLIENTS
@@ -770,6 +898,16 @@ _ALL = (
             ("BENCH_VERIFYD_ROUNDS", 8, 2),
         ),
         skip_env=("BENCH_SKIP_VERIFYD",),
+    ),
+    Section(
+        "verifyd_tenants",
+        run_verifyd_tenants,
+        needs_jax=False,
+        degrade=(
+            ("BENCH_TENANTS_ROUNDS", 30, 10),
+            ("BENCH_TENANTS_FLOODS", 4, 1),
+        ),
+        skip_env=("BENCH_SKIP_VERIFYD_TENANTS",),
     ),
     Section(
         "light_serve",

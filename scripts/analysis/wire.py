@@ -21,6 +21,13 @@ makes that reasoning mechanical for ``verifyd/protocol.py`` and
 - TPW003 — grpc-status trailer emitted only when the status is truthy:
   ``grpc-status: 0`` (OK) must still be sent; a conditional emit makes
   every success look like a missing status to conforming clients.
+- TPW004 — a string/bytes field omitted when it equals a named default
+  (``if x.attr and x.attr != DEFAULT: encode_string_field(...)``) whose
+  decoder never re-establishes that default: an omitted field decodes
+  as empty instead of the constant the encoder elided. Safe shapes are
+  a decode-side ``x.attr = x.attr or DEFAULT`` normalization, a
+  pre-loop ``attr = DEFAULT`` local, or the dataclass field default
+  being the same constant.
 
 Enum families are discovered structurally from the ``X_NAMES =
 {CONST: "name"}`` dicts the protocol modules already maintain, so new
@@ -36,6 +43,7 @@ from scripts.analysis.core import Checker, Finding, Module, dotted_name, parent_
 
 _WIRE_FILES = ("verifyd/protocol.py", "libs/grpc.py")
 _EMIT_FNS = {"_put_varint", "_varint", "put_varint", "_tag", "_put_tag"}
+_STR_EMIT_FNS = {"encode_string_field", "encode_bytes_field"}
 
 
 class _EnumFamily:
@@ -69,6 +77,7 @@ class WireCompatChecker(Checker):
         "TPW001": "zero-omitted enum field where 0 is meaningful and unshifted",
         "TPW002": "asymmetric +1/-1 wire shift between encode and decode",
         "TPW003": "grpc-status trailer emitted conditionally on truthiness",
+        "TPW004": "default-omitted string field never re-established on decode",
     }
 
     def check_module(self, module: Module) -> Iterator[Finding]:
@@ -79,6 +88,7 @@ class WireCompatChecker(Checker):
         yield from self._check_zero_omission(module, families, consts)
         yield from self._check_shift_symmetry(module, families)
         yield from self._check_grpc_status(module)
+        yield from self._check_default_omission(module)
 
     # --- enum discovery ------------------------------------------------------
 
@@ -304,3 +314,112 @@ class WireCompatChecker(Checker):
                     break
                 if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     break
+
+    # --- TPW004: default-omitted string fields --------------------------------
+
+    def _default_guard_const(
+        self, parents: Dict[ast.AST, ast.AST], node: ast.Call, attr: str
+    ) -> Optional[str]:
+        """CONST name in an enclosing ``if x.attr != CONST`` guard.
+
+        Truthiness-only guards (``if x.attr:``) omit the empty string,
+        whose decode default IS empty — those are safe and return None.
+        """
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            cur = parents.get(cur)
+            if not isinstance(cur, ast.If):
+                continue
+            tests = (
+                cur.test.values
+                if isinstance(cur.test, ast.BoolOp)
+                else [cur.test]
+            )
+            for test in tests:
+                if not (
+                    isinstance(test, ast.Compare)
+                    and len(test.ops) == 1
+                    and isinstance(test.ops[0], ast.NotEq)
+                ):
+                    continue
+                sides = [test.left, test.comparators[0]]
+                attrs = [
+                    s for s in sides
+                    if isinstance(s, ast.Attribute) and s.attr == attr
+                ]
+                names = [s for s in sides if isinstance(s, ast.Name)]
+                if attrs and names:
+                    return names[0].id
+        return None
+
+    def _reestablishes(self, module: Module, attr: str, const: str) -> bool:
+        """Does any decode path restore ``attr`` to ``const``?"""
+        for node in ast.walk(module.tree):
+            # `x.attr = x.attr or CONST` / `attr = attr or CONST`
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.BoolOp
+            ) and isinstance(node.value.op, ast.Or):
+                targets_attr = any(
+                    (isinstance(t, ast.Attribute) and t.attr == attr)
+                    or (isinstance(t, ast.Name) and t.id == attr)
+                    for t in node.targets
+                )
+                restores = any(
+                    isinstance(v, ast.Name) and v.id == const
+                    for v in node.value.values
+                )
+                if targets_attr and restores:
+                    return True
+            # pre-loop local default: `attr = CONST`
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Name
+            ) and node.value.id == const:
+                if any(
+                    isinstance(t, ast.Name) and t.id == attr
+                    for t in node.targets
+                ):
+                    return True
+            # dataclass field default: `attr: str = CONST`
+            if (
+                isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == attr
+                and isinstance(node.value, ast.Name)
+                and node.value.id == const
+            ):
+                return True
+        return False
+
+    def _check_default_omission(self, module: Module) -> Iterator[Finding]:
+        parents = parent_map(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+            if fn not in _STR_EMIT_FNS:
+                continue
+            attr = next(
+                (
+                    a.attr
+                    for a in node.args
+                    if isinstance(a, ast.Attribute)
+                    and isinstance(a.value, ast.Name)
+                ),
+                None,
+            )
+            if attr is None:
+                continue
+            const = self._default_guard_const(parents, node, attr)
+            if const is None:
+                continue
+            if self._reestablishes(module, attr, const):
+                continue
+            yield Finding(
+                module.rel,
+                node.lineno,
+                "TPW004",
+                f"field '{attr}' is omitted when it equals {const}, but "
+                "no decode path re-establishes that default; an omitted "
+                f"field decodes as empty, not {const} — add "
+                f"`x.{attr} = x.{attr} or {const}` after parsing",
+            )

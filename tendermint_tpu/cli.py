@@ -535,6 +535,13 @@ def cmd_verifyd(args) -> int:
         max_delay=args.max_delay,
         admission_cap=args.admission_cap,
         max_pending=args.max_pending,
+        continuous=(
+            None if args.continuous == "auto" else args.continuous == "on"
+        ),
+        pipeline_depth=args.pipeline_depth,
+        tenant_cap=args.tenant_cap,
+        tenant_pin_quota=args.tenant_pin_quota,
+        max_tenants=args.max_tenants,
         metrics=VerifydMetrics(reg),
         evloop_metrics=EvloopMetrics(reg),
     )
@@ -557,7 +564,9 @@ def cmd_verifyd(args) -> int:
     print(
         f"verifyd serving on {shost}:{sport} "
         f"(max_batch={server.max_batch}, max_delay={args.max_delay}s, "
-        f"admission_cap={args.admission_cap})",
+        f"admission_cap={args.admission_cap}, "
+        f"continuous={server.scheduler.continuous}, "
+        f"tenant_cap={args.tenant_cap})",
         flush=True,
     )
     try:
@@ -1081,6 +1090,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--max-pending", type=int, default=4096,
         help="hard pending-lane cap for ALL classes",
+    )
+    p.add_argument(
+        "--continuous", choices=("auto", "on", "off"), default="auto",
+        help="continuous batching (dispatch pipeline): auto follows "
+        "TENDERMINT_TPU_CONT_BATCH (default on); off restores the "
+        "flush-barrier path",
+    )
+    p.add_argument(
+        "--pipeline-depth", type=int, default=2,
+        help="dispatches outstanding at once under continuous batching",
+    )
+    p.add_argument(
+        "--tenant-cap", type=int, default=512,
+        help="outstanding sheddable lanes one tenant may hold",
+    )
+    p.add_argument(
+        "--tenant-pin-quota", type=int, default=256,
+        help="resident-table pins one tenant may hold (ops/resident.py)",
+    )
+    p.add_argument(
+        "--max-tenants", type=int, default=16,
+        help="distinct tenant metric/budget buckets; overflow shares one",
     )
     p.add_argument(
         "--metrics", default="", metavar="HOST:PORT",

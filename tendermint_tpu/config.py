@@ -112,6 +112,10 @@ class OpsConfig:
     # local accelerator. Empty = local verification. The
     # TENDERMINT_TPU_VERIFY_REMOTE env var applies when this is empty.
     verify_remote: str = ""
+    # Tenant/chain namespace this node's remote verification traffic
+    # rides under (multi-tenant verifyd: per-tenant admission budgets,
+    # resident-table quotas, metrics). Empty = the default tenant.
+    verify_tenant: str = ""
     # Devices the sharded verify engine may span (parallel/mesh.py).
     # 0 = all available devices; 1 disables sharding. The
     # TENDERMINT_TPU_MESH env var applies when this is 0.
@@ -197,6 +201,7 @@ class Config:
             double_sign_check_height=self.consensus.double_sign_check_height,
             trace=self.base.trace,
             verify_remote=self.ops.verify_remote,
+            verify_tenant=self.ops.verify_tenant,
             mesh_devices=self.ops.mesh_devices,
             resident_tables=self.ops.resident_tables,
         )

@@ -16,6 +16,19 @@ Per-entry verdicts come from the batch verifier's attribution (the
 reference's BatchVerifier.Verify bool slice, crypto/crypto.go:58-76), so
 one bad signature fails only its own future.
 
+Continuous batching (the serving-tier analog of the ops engines' chunk
+double-buffering): by default the accumulator does NOT run ``verify_fn``
+itself. It hands selected batches to a small pool of dispatch workers
+(``pipeline_depth`` of them) and immediately goes back to accumulating —
+newly-arrived lanes are admitted into the NEXT device dispatch while the
+current kernel is in flight, so host-side prep overlaps device work at
+the service level and tail latency under mixed load stops being
+quantized by super-batch boundaries. At most ``pipeline_depth`` batches
+are outstanding (queued + in flight); past that the accumulator holds
+lanes, which is the natural backpressure. ``TENDERMINT_TPU_CONT_BATCH=off``
+(or ``continuous=False``) restores the historical flush-barrier path
+where the accumulator verifies inline — kept for A/B benchmarking.
+
 Serving extensions (used by verifyd, available to any caller):
 
 - per-entry ``priority`` — when more work is pending than one batch
@@ -24,13 +37,17 @@ Serving extensions (used by verifyd, available to any caller):
 - per-entry ``flush_by`` — an absolute monotonic deadline that pulls
   the flush earlier than ``max_delay`` when a wire deadline would
   otherwise expire while the lane sits in the accumulator;
+- per-entry ``tenant`` — opaque namespace label carried through to the
+  ``on_flush`` observer so a multi-tenant front-end can attribute
+  flush composition per tenant;
 - ``max_pending`` backpressure — ``submit`` raises
   ``SchedulerSaturatedError`` past the cap instead of growing the
   queue unboundedly (callers surface this as RESOURCE_EXHAUSTED);
-- ``flush_reasons`` counters (``size``/``deadline``/``shutdown``) and
-  an ``on_flush(reason, batch, seconds)`` callback, invoked BEFORE the
-  futures resolve so observers see the flush strictly-before any
-  waiter wakes.
+- ``flush_reasons`` counters (``size``/``deadline``/``shutdown``), an
+  ``on_flush(reason, batch, seconds)`` callback invoked BEFORE the
+  futures resolve, and an ``on_dispatch(depth, lanes, reason)``
+  callback fired at hand-off time with the outstanding-dispatch depth
+  (the continuous-batching occupancy signal).
 
 Wiring: callers that ingest signatures from many concurrent sources
 (per-peer vote floods, RPC broadcast storms) submit here instead of
@@ -41,6 +58,7 @@ latency-optimal for one caller.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -50,6 +68,18 @@ from tendermint_tpu.libs import tracing
 
 DEFAULT_MAX_BATCH = 256
 DEFAULT_MAX_DELAY = 0.002  # 2ms: well under a vote round-trip
+
+# continuous-batching knob: "off"/"0"/"false"/"no" restores the
+# flush-barrier path (accumulator verifies inline); anything else — and
+# unset — runs the dispatch-worker pipeline.
+CONT_BATCH_ENV = "TENDERMINT_TPU_CONT_BATCH"
+DEFAULT_PIPELINE_DEPTH = 2  # batches outstanding: one in flight, one next
+
+
+def continuous_default() -> bool:
+    """Env-resolved default for the continuous dispatch pipeline."""
+    val = os.environ.get(CONT_BATCH_ENV, "on").strip().lower()
+    return val not in ("off", "0", "false", "no")
 
 
 def default_max_batch() -> int:
@@ -81,6 +111,7 @@ class _Pending:
     priority: int = 0  # lower flushes first when over-subscribed
     flush_by: Optional[float] = None  # absolute monotonic wire deadline
     tag: Optional[object] = None  # submitter identity (e.g. connection)
+    tenant: Optional[str] = None  # namespace label (multi-tenant verifyd)
 
     def due(self, max_delay: float) -> float:
         """Absolute monotonic time this entry must be flushed by."""
@@ -118,6 +149,9 @@ class VerifyScheduler:
         on_flush: Optional[
             Callable[[str, List[_Pending], float], None]
         ] = None,
+        continuous: Optional[bool] = None,
+        pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+        on_dispatch: Optional[Callable[[int, int, str], None]] = None,
     ):
         self._verify_fn = verify_fn
         self._fallback_fn = fallback_fn
@@ -130,20 +164,38 @@ class VerifyScheduler:
         # explicit wire rejection.
         self.max_pending = max_pending
         self._on_flush = on_flush
+        self._on_dispatch = on_dispatch
+        # None = env default (on unless TENDERMINT_TPU_CONT_BATCH=off)
+        self.continuous = (
+            continuous_default() if continuous is None else bool(continuous)
+        )
+        self.pipeline_depth = max(1, pipeline_depth)
         self._pending: List[_Pending] = []  # guarded-by: _mtx
         self._mtx = threading.Lock()
         self._wake = threading.Condition(self._mtx)
+        # the dispatch stage: the accumulator appends (reason, batch)
+        # here and workers pop; bounded at pipeline_depth outstanding
+        # (queued + in flight) so a slow device backs pressure up into
+        # the accumulator instead of an unbounded hand-off queue.
+        self._dispatch_q: List[Tuple[str, List[_Pending]]] = []  # guarded-by: _mtx
+        self._dispatch_wake = threading.Condition(self._mtx)
+        self._inflight = 0  # dispatches inside verify_fn  # guarded-by: _mtx
+        self._inflight_lanes = 0  # lanes handed off, unresolved  # guarded-by: _mtx
         self._stop = False  # guarded-by: _mtx
         self._thread: Optional[threading.Thread] = None  # guarded-by: _mtx
-        # observability — single-writer: only the accumulator thread (and
-        # post-join stop()) mutate these; racy reads are stats-grade.
-        self.flushes = 0  # guarded-by: none(single-writer stats)
-        self.entries_verified = 0  # guarded-by: none(single-writer stats)
-        self.entries_coalesced = 0  # guarded-by: none(single-writer stats)
-        self.flush_errors = 0  # guarded-by: none(single-writer stats)
-        self.fallback_flushes = 0  # guarded-by: none(single-writer stats)
-        self.submit_rejections = 0  # guarded-by: none(single-writer stats)
-        self.flush_reasons = {"size": 0, "deadline": 0, "shutdown": 0}  # guarded-by: none(single-writer stats)
+        self._workers: List[threading.Thread] = []  # guarded-by: _mtx
+        # observability — flush-side counters are written by every
+        # dispatch worker (plus the accumulator on the barrier path and
+        # stop()), so they all ride _mtx now.
+        self.flushes = 0  # guarded-by: _mtx
+        self.entries_verified = 0  # guarded-by: _mtx
+        self.entries_coalesced = 0  # guarded-by: _mtx
+        self.flush_errors = 0  # guarded-by: _mtx
+        self.fallback_flushes = 0  # guarded-by: _mtx
+        self.submit_rejections = 0  # guarded-by: _mtx
+        self.dispatch_handoffs = 0  # guarded-by: _mtx
+        self.inflight_admissions = 0  # lanes admitted mid-dispatch  # guarded-by: _mtx
+        self.flush_reasons = {"size": 0, "deadline": 0, "shutdown": 0}  # guarded-by: _mtx
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -157,21 +209,41 @@ class VerifyScheduler:
                 target=self._run, name="verify-scheduler", daemon=True
             )
             self._thread.start()
+            if self.continuous:
+                for i in range(self.pipeline_depth):
+                    w = threading.Thread(
+                        target=self._dispatch_run,
+                        name=f"verify-dispatch-{i}",
+                        daemon=True,
+                    )
+                    w.start()
+                    self._workers.append(w)
 
     def stop(self) -> None:
         with self._wake:
             self._stop = True
             self._wake.notify_all()
+            self._dispatch_wake.notify_all()
             # snapshot under the lock (a concurrent start() may race us);
             # join OUTSIDE it — the accumulator needs _mtx to drain.
             thread, self._thread = self._thread, None
+            workers, self._workers = list(self._workers), []
         if thread is not None:
             thread.join(timeout=5)
-        # fail any stragglers closed rather than hanging their callers
+        for w in workers:
+            w.join(timeout=5)
+        # fail any stragglers closed rather than hanging their callers:
+        # both the accumulator's pending set and batches stuck in the
+        # hand-off queue (a worker that died mid-join keeps its popped
+        # batch; it resolves those itself when the flush returns).
         with self._mtx:
             leftovers, self._pending = self._pending, []
+            for _reason, batch in self._dispatch_q:
+                leftovers.extend(batch)
+            self._dispatch_q = []
+            if leftovers:
+                self.flush_reasons["shutdown"] += 1
         if leftovers:
-            self.flush_reasons["shutdown"] += 1
             self._notify_flush("shutdown", leftovers, 0.0)
         for p in leftovers:
             p.ok = False
@@ -188,6 +260,7 @@ class VerifyScheduler:
         priority: int = 0,
         flush_by: Optional[float] = None,
         tag: Optional[object] = None,
+        tenant: Optional[str] = None,
     ) -> _Pending:
         """Enqueue one signature; returns a handle for ``wait``. Callers
         with several signatures submit all first so one flush covers
@@ -200,6 +273,7 @@ class VerifyScheduler:
             priority=priority,
             flush_by=flush_by,
             tag=tag,
+            tenant=tenant,
         )
         with self._wake:
             if self._stop or self._thread is None:
@@ -210,7 +284,16 @@ class VerifyScheduler:
                     f"verify queue full ({self.max_pending} pending)"
                 )
             self._pending.append(entry)
+            inflight = self._inflight
+            if inflight:
+                self.inflight_admissions += 1
             self._wake.notify_all()
+        if inflight:
+            # the continuous-batching proof point: this lane joined the
+            # NEXT dispatch while a kernel was already in flight
+            tracing.instant(
+                "scheduler_admit_inflight", lanes=1, inflight=inflight
+            )
         return entry
 
     def submit_many(
@@ -220,6 +303,7 @@ class VerifyScheduler:
         priority: int = 0,
         flush_by: Optional[float] = None,
         tag: Optional[object] = None,
+        tenant: Optional[str] = None,
     ) -> List[_Pending]:
         """Atomically enqueue a whole lane group under ONE lock round and
         ONE accumulator wake-up. This is the super-batch entry point for
@@ -232,7 +316,7 @@ class VerifyScheduler:
         now = time.monotonic()
         entries = [
             _Pending(pk, msg, sig, now, priority=priority,
-                     flush_by=flush_by, tag=tag)
+                     flush_by=flush_by, tag=tag, tenant=tenant)
             for pk, msg, sig in lanes
         ]
         with self._wake:
@@ -246,7 +330,16 @@ class VerifyScheduler:
                     f"verify queue full ({self.max_pending} pending)"
                 )
             self._pending.extend(entries)
+            inflight = self._inflight
+            if inflight:
+                self.inflight_admissions += len(entries)
             self._wake.notify_all()
+        if inflight and entries:
+            tracing.instant(
+                "scheduler_admit_inflight",
+                lanes=len(entries),
+                inflight=inflight,
+            )
         return entries
 
     def wait_many(
@@ -269,6 +362,19 @@ class VerifyScheduler:
         """Entries accumulated but not yet handed to a flush."""
         with self._mtx:
             return len(self._pending)
+
+    def load_depth(self) -> int:
+        """Total unresolved lanes: accumulated + handed off + in flight.
+        The admission-control signal — on the continuous path lanes
+        leave ``pending_depth`` the moment a dispatch slot frees, but
+        they still consume service time until their flush returns."""
+        with self._mtx:
+            return len(self._pending) + self._inflight_lanes
+
+    def dispatch_depth(self) -> int:
+        """Outstanding dispatches (queued + inside verify_fn)."""
+        with self._mtx:
+            return self._inflight + len(self._dispatch_q)
 
     def wait(self, entry: _Pending, timeout: float = 10.0) -> bool:
         """Block until the entry's batch flushed; False on timeout (fail
@@ -295,11 +401,28 @@ class VerifyScheduler:
         except Exception:
             pass  # observers never break the drain loop
 
+    def _notify_dispatch(self, depth: int, lanes: int, reason: str) -> None:
+        if self._on_dispatch is None:
+            return
+        try:
+            self._on_dispatch(depth, lanes, reason)
+        except Exception:
+            pass  # observers never break the dispatch loop
+
     def _run(self) -> None:
         while True:
             reason = "size"
             with self._wake:
                 while not self._stop:
+                    if self.continuous and (
+                        self._inflight + len(self._dispatch_q)
+                        >= self.pipeline_depth
+                    ):
+                        # every dispatch slot is taken: keep accumulating
+                        # (that IS the backpressure); a slot release
+                        # notifies _dispatch_wake and we re-evaluate
+                        self._dispatch_wake.wait(timeout=0.05)
+                        continue
                     if len(self._pending) >= self.max_batch:
                         reason = "size"
                         break
@@ -332,17 +455,65 @@ class VerifyScheduler:
                     ]
                 else:
                     batch, self._pending = self._pending, []
+                if batch and self.continuous:
+                    # hand off and go straight back to accumulating:
+                    # lanes arriving now join the NEXT dispatch while
+                    # this one runs (continuous batching)
+                    self._dispatch_q.append((reason, batch))
+                    self._inflight_lanes += len(batch)
+                    self.dispatch_handoffs += 1
+                    depth = self._inflight + len(self._dispatch_q)
+                    self._dispatch_wake.notify_all()
             if not batch:
                 continue
-            # Coalesce duplicate (pubkey, msg, sig) submissions: a vote
-            # gossiped by k peers lands k times inside one deadline
-            # window but costs one verifier lane; the verdict fans out
-            # to every waiting future.
-            pks: List[bytes] = []
-            msgs: List[bytes] = []
-            sigs: List[bytes] = []
-            index: dict = {}
-            slots: List[int] = []
+            if self.continuous:
+                self._notify_dispatch(depth, len(batch), reason)
+            else:
+                # barrier path (A/B baseline): verify inline, blocking
+                # accumulation until the kernel returns
+                self._notify_dispatch(1, len(batch), reason)
+                self._flush_one(reason, batch, depth=1)
+
+    # --- dispatch workers ----------------------------------------------------
+
+    def _dispatch_run(self) -> None:
+        while True:
+            with self._mtx:
+                while not self._stop and not self._dispatch_q:
+                    self._dispatch_wake.wait(timeout=0.1)
+                if self._stop:
+                    return
+                reason, batch = self._dispatch_q.pop(0)
+                self._inflight += 1
+                depth = self._inflight + len(self._dispatch_q)
+            try:
+                self._flush_one(reason, batch, depth)
+            finally:
+                with self._mtx:
+                    self._inflight -= 1
+                    self._inflight_lanes -= len(batch)
+                    # a freed slot is what the accumulator (and any
+                    # other worker) waits on
+                    self._dispatch_wake.notify_all()
+
+    # --- flush ---------------------------------------------------------------
+
+    def _flush_one(
+        self, reason: str, batch: List[_Pending], depth: int
+    ) -> None:
+        # Coalesce duplicate (pubkey, msg, sig) submissions: a vote
+        # gossiped by k peers lands k times inside one deadline
+        # window but costs one verifier lane; the verdict fans out
+        # to every waiting future.
+        pks: List[bytes] = []
+        msgs: List[bytes] = []
+        sigs: List[bytes] = []
+        index: dict = {}
+        slots: List[int] = []
+        had_error = used_fallback = False
+        with tracing.span(
+            "scheduler_dispatch", lanes=len(batch), reason=reason, depth=depth
+        ):
             with tracing.span("sched_assemble", lanes=len(batch)) as asp:
                 for p in batch:
                     key = (p.pubkey, p.msg, p.sig)
@@ -354,31 +525,36 @@ class VerifyScheduler:
                         sigs.append(p.sig)
                     slots.append(idx)
                 asp.set(unique=len(pks), coalesced=len(batch) - len(pks))
-            self.entries_coalesced += len(batch) - len(pks)
             t0 = time.monotonic()
             with tracing.span("sched_flush", lanes=len(pks), reason=reason):
                 try:
                     oks = self._verify_fn(pks, msgs, sigs)
                 except Exception:
-                    self.flush_errors += 1
+                    had_error = True
                     oks = None
                     if self._fallback_fn is not None:
                         try:
                             oks = self._fallback_fn(pks, msgs, sigs)
-                            self.fallback_flushes += 1
+                            used_fallback = True
                         except Exception:
                             oks = None
                     if oks is None:
                         # fail closed, never hang callers
                         oks = [False] * len(pks)
-            if len(oks) != len(pks):  # misbehaving verifier: fail closed
-                oks = [False] * len(pks)
+        if len(oks) != len(pks):  # misbehaving verifier: fail closed
+            oks = [False] * len(pks)
+        with self._mtx:
             self.flushes += 1
             self.flush_reasons[reason] += 1
             self.entries_verified += len(batch)
-            # observers run strictly-before the futures resolve, so a
-            # waiter that wakes can already see its flush accounted for
-            self._notify_flush(reason, batch, time.monotonic() - t0)
-            for p, idx in zip(batch, slots):
-                p.ok = bool(oks[idx])
-                p.done.set()
+            self.entries_coalesced += len(batch) - len(pks)
+            if had_error:
+                self.flush_errors += 1
+            if used_fallback:
+                self.fallback_flushes += 1
+        # observers run strictly-before the futures resolve, so a
+        # waiter that wakes can already see its flush accounted for
+        self._notify_flush(reason, batch, time.monotonic() - t0)
+        for p, idx in zip(batch, slots):
+            p.ok = bool(oks[idx])
+            p.done.set()

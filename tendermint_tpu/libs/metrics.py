@@ -569,6 +569,51 @@ class VerifydMetrics(_NopMixin):
             "Flushes whose lanes came from more than one client connection.",
             labels=("reason",),
         )
+        self.dispatch_occupancy = reg.histogram(
+            _name(s, "dispatch_occupancy"),
+            "Outstanding dispatches (queued + in flight) at each"
+            " scheduler hand-off — the continuous-batching pipeline"
+            " depth.",
+            buckets=(1, 2, 3, 4, 6, 8),
+        )
+        self.brownout_level = reg.gauge(
+            _name(s, "brownout_level"),
+            "Current degradation-ladder rung (0=normal .."
+            " 5=host_consensus).",
+        )
+        self.brownout_transitions = reg.counter(
+            _name(s, "brownout_transitions_total"),
+            "Degradation-ladder moves, by direction (up/down).",
+            labels=("direction",),
+        )
+        # tenant labels are sanitized AND capped server-side (at most
+        # max_tenants distinct values, overflow collapses to "other"),
+        # so this family's cardinality is bounded by construction
+        self.tenant_lanes = reg.counter(
+            _name(s, "tenant_lanes_total"),
+            "Signature lanes admitted, by tenant namespace.",
+            labels=("tenant",),
+        )
+        self.tenant_rejections = reg.counter(
+            _name(s, "tenant_rejections_total"),
+            "Requests shed, by tenant namespace and shed reason.",
+            labels=("tenant", "reason"),
+        )
+        self.tenant_queue_depth = reg.gauge(
+            _name(s, "tenant_queue_depth"),
+            "Outstanding (admitted, unresolved) lanes, by tenant.",
+            labels=("tenant",),
+        )
+        self.tenant_request_seconds = reg.histogram(
+            _name(s, "tenant_request_seconds"),
+            "Wire latency per request, by tenant namespace.",
+            labels=("tenant",),
+        )
+        self.host_direct_lanes = reg.counter(
+            _name(s, "host_direct_lanes_total"),
+            "Consensus lanes verified on the host oracle by the"
+            " brownout ladder's shrink_shares/host_consensus rungs.",
+        )
 
 
 class EvloopMetrics(_NopMixin):

@@ -98,6 +98,10 @@ class NodeConfig:
     # device-worthy signature batches to a verifyd daemon instead of a
     # local accelerator ("" = local verification).
     verify_remote: str = ""
+    # Tenant/chain namespace the remote verifier files this node's
+    # traffic under ([ops] verify_tenant): per-tenant budgets, quotas,
+    # and metrics server-side. "" = the default tenant.
+    verify_tenant: str = ""
     # Devices the sharded verify engine may span ([ops] mesh_devices /
     # the TENDERMINT_TPU_MESH env var): 0 = all available, 1 disables
     # sharding (parallel/mesh.py).
@@ -353,6 +357,8 @@ class Node:
             from tendermint_tpu.verifyd import client as _vclient
 
             _vclient.set_remote_addr(config.verify_remote)
+            if config.verify_tenant:
+                _vclient.set_remote_tenant(config.verify_tenant)
 
         # --- pools + executor (node.go:258-297) ------------------------------
         self.mempool = TxMempool(

@@ -85,6 +85,8 @@ class ResidentTableStore:
         self.gathered_h2d_bytes = 0  # guarded-by: _lock
         self.invalidations = 0  # guarded-by: _lock
         self._hot_counts: Dict[bytes, int] = {}  # guarded-by: _lock
+        self._tenant_pins: Dict[str, int] = {}  # guarded-by: _lock
+        self.pin_quota_denials = 0  # guarded-by: _lock
 
     # --- configuration ------------------------------------------------------
 
@@ -291,10 +293,22 @@ class ResidentTableStore:
 
     # --- verifyd / accounting hooks ----------------------------------------
 
-    def note_hot_keys(self, pubkeys: Iterable[bytes]) -> None:
+    def note_hot_keys(
+        self,
+        pubkeys: Iterable[bytes],
+        tenant: Optional[str] = None,
+        quota: int = 0,
+    ) -> None:
         """Count repeat signers from set-less traffic (verifyd): a key
         seen ``_HOT_PIN_THRESHOLD`` times gets pinned in the host cache
-        so it joins the next resident upload."""
+        so it joins the next resident upload.
+
+        ``tenant``/``quota`` cap how many pins one namespace may hold
+        (multi-tenant verifyd): past ``quota`` pins, a tenant's further
+        hot keys are counted as ``pin_quota_denials`` instead of pinned,
+        so one chain's validator universe can't monopolize the resident
+        tensor. ``quota=0`` (or no tenant) keeps the unlimited behavior.
+        """
         to_pin = []
         with self._lock:
             for pk in pubkeys:
@@ -303,6 +317,13 @@ class ResidentTableStore:
                     continue
                 c = self._hot_counts.get(pk, 0) + 1
                 if c >= _HOT_PIN_THRESHOLD:
+                    if tenant is not None and quota > 0:
+                        used = self._tenant_pins.get(tenant, 0)
+                        if used >= quota:
+                            self.pin_quota_denials += 1
+                            self._hot_counts.pop(pk, None)
+                            continue
+                        self._tenant_pins[tenant] = used + 1
                     self._hot_counts.pop(pk, None)
                     to_pin.append(pk)
                 elif len(self._hot_counts) < _HOT_TRACK_CAP:
@@ -332,15 +353,23 @@ class ResidentTableStore:
                 "h2d_bytes": self.h2d_bytes,
                 "gathered_h2d_bytes": self.gathered_h2d_bytes,
                 "invalidations": self.invalidations,
+                "pin_quota_denials": self.pin_quota_denials,
             }
+
+    def tenant_pins(self) -> Dict[str, int]:
+        """Pins held per tenant namespace (quota introspection)."""
+        with self._lock:
+            return dict(self._tenant_pins)
 
     def reset(self) -> None:
         with self._lock:
             self._drop_locked()
             self._hot_counts.clear()
+            self._tenant_pins.clear()
             self.hits = self.misses = self.uploads = 0
             self.h2d_bytes = self.gathered_h2d_bytes = 0
             self.invalidations = 0
+            self.pin_quota_denials = 0
 
 
 # --- process-wide singleton --------------------------------------------------
@@ -381,8 +410,12 @@ def bind_metrics(metrics) -> None:
     store.bind_metrics(metrics)
 
 
-def note_hot_keys(pubkeys: Iterable[bytes]) -> None:
-    store.note_hot_keys(pubkeys)
+def note_hot_keys(
+    pubkeys: Iterable[bytes],
+    tenant: Optional[str] = None,
+    quota: int = 0,
+) -> None:
+    store.note_hot_keys(pubkeys, tenant=tenant, quota=quota)
 
 
 def note_table_h2d(nbytes: int) -> None:

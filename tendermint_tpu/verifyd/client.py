@@ -7,18 +7,26 @@ every seam that takes a verify_fn: the shared ``VerifyScheduler``
 through it ``types/validation.verify_commit``), and ``light/verifier``.
 
 Failure semantics (fail AVAILABLE, not open): connection loss retries
-with exponential backoff across a small channel pool; a dead server,
-an admission rejection (RESOURCE_EXHAUSTED), or an expired deadline
-degrade to the local host oracle (``verify_zip215`` / sr25519 host
-verify) when ``fallback`` is enabled — verdicts stay sound because the
-host oracle is the same ZIP-215 ground truth the device kernels are
-tested against. With ``fallback=False`` the caller sees
+with exponential backoff across a small channel pool. An admission
+rejection (RESOURCE_EXHAUSTED) is a *shed*, not a death sentence: the
+client retries it with deadline-jittered exponential backoff against
+the REMAINING deadline, up to a bounded ``shed_retries`` budget —
+sheds are transient by design (the brownout ladder recovers), so a
+beat of patience usually beats burning host CPU. Only once the budget
+(or the deadline) is exhausted — or the server is unreachable / the
+deadline expired server-side — does the call degrade to the local
+host oracle (``verify_zip215`` / sr25519 host verify) when
+``fallback`` is enabled; verdicts stay sound because the host oracle
+is the same ZIP-215 ground truth the device kernels are tested
+against. With ``fallback=False`` the caller sees
 ``VerifydRejectedError`` / ``VerifydUnavailableError`` instead.
 
 Selection: ``TENDERMINT_TPU_VERIFY_REMOTE=<host:port>`` env or the
 ``[ops] verify_remote`` config key (plumbed via node assembly into
 ``set_remote_addr``). ``remote_backend()`` returns the process-wide
-client's verify_fn, or None when no remote is configured.
+client's verify_fn, or None when no remote is configured. The tenant
+namespace rides every request: ``set_remote_tenant`` (config
+``[ops] verify_tenant``) labels this node's traffic server-side.
 
 Workload classes ride a thread-local set by ``classify(klass)`` at the
 call sites that know the work's nature (consensus commit verification,
@@ -29,6 +37,7 @@ package's "light" labeling is not overridden by validation internals.
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 from contextlib import contextmanager
@@ -44,6 +53,7 @@ from tendermint_tpu.verifyd.protocol import (
     CLASS_CONSENSUS,
     CLASS_LIGHT,
     CLASS_RPC,
+    DEFAULT_TENANT,
     KIND_COMMIT,
     KIND_HEADER,
     KIND_RAW,
@@ -131,6 +141,9 @@ class VerifydClient:
         retries: int = 3,
         backoff: float = 0.05,
         fallback: bool = True,
+        tenant: str = DEFAULT_TENANT,
+        shed_retries: int = 2,
+        shed_backoff: float = 0.02,
     ):
         host, _, port = addr.rpartition(":")
         if not host or not port.isdigit():
@@ -142,6 +155,12 @@ class VerifydClient:
         self.retries = retries
         self.backoff = backoff
         self.fallback = fallback
+        self.tenant = tenant or DEFAULT_TENANT
+        # RESOURCE_EXHAUSTED retry budget: sheds are transient (the
+        # server's brownout ladder recovers), so wait-and-retry against
+        # the remaining deadline before surrendering to the fallback
+        self.shed_retries = max(0, shed_retries)
+        self.shed_backoff = shed_backoff
         self._mtx = threading.Lock()
         self._pool: List[GrpcChannel] = []
         self._free: List[GrpcChannel] = []
@@ -151,6 +170,7 @@ class VerifydClient:
         self.calls = 0
         self.transport_retries = 0
         self.fallback_calls = 0
+        self.shed_retries_used = 0
         self.rejected = {}  # status -> count
 
     def _acquire(self) -> GrpcChannel:
@@ -257,39 +277,75 @@ class VerifydClient:
             kind = _CLASS_KIND.get(klass, KIND_RAW)
         if deadline is None:
             deadline = self.timeout
-        req = VerifyRequest(
-            kind=kind,
-            klass=klass,
-            deadline_ms=max(1, int(deadline * 1000)),
-            algo=algo,
-            pks=list(pks),
-            msgs=list(msgs),
-            sigs=list(sigs),
-        )
+        t0 = time.monotonic()
         with tracing.span(
-            "verifyd_call", lanes=len(req), klass=klass, algo=algo
+            "verifyd_call", lanes=len(pks), klass=klass, algo=algo
         ) as sp:
-            try:
-                # transport grace past the verify deadline: the server
-                # answers DEADLINE_EXCEEDED at exactly `deadline`; the
-                # wire timeout must not race that response
-                resp = self.call(req, timeout=deadline + 0.5)
-            except VerifydUnavailableError:
-                if not self.fallback:
-                    raise
-                sp.set(outcome="fallback_unavailable")
-                self.fallback_calls += 1
-                return _host_verify(algo, pks, msgs, sigs)
+            delay = self.shed_backoff
+            sheds = 0
+            while True:
+                # the remaining deadline shrinks across shed retries so
+                # the retried request carries an honest wire deadline
+                remaining = deadline - (time.monotonic() - t0)
+                if remaining <= 0:
+                    resp = protocol.VerifyResponse(
+                        status=protocol.STATUS_DEADLINE_EXCEEDED,
+                        message="deadline spent across shed retries",
+                    )
+                    break
+                req = VerifyRequest(
+                    kind=kind,
+                    klass=klass,
+                    deadline_ms=max(1, int(remaining * 1000)),
+                    algo=algo,
+                    pks=list(pks),
+                    msgs=list(msgs),
+                    sigs=list(sigs),
+                    tenant=self.tenant,
+                )
+                try:
+                    # transport grace past the verify deadline: the
+                    # server answers DEADLINE_EXCEEDED at exactly
+                    # `deadline`; the wire timeout must not race that
+                    resp = self.call(req, timeout=remaining + 0.5)
+                except VerifydUnavailableError:
+                    if not self.fallback:
+                        raise
+                    sp.set(outcome="fallback_unavailable", sheds=sheds)
+                    self.fallback_calls += 1
+                    return _host_verify(algo, pks, msgs, sigs)
+                if (
+                    resp.status == protocol.STATUS_RESOURCE_EXHAUSTED
+                    and sheds < self.shed_retries
+                ):
+                    # shed: back off (jittered exponential, bounded by
+                    # the remaining deadline) and try again — the
+                    # brownout that shed us is designed to recover
+                    sheds += 1
+                    self.shed_retries_used += 1
+                    remaining = deadline - (time.monotonic() - t0)
+                    pause = min(
+                        delay * (0.5 + random.random() * 0.5),
+                        max(0.0, remaining),
+                    )
+                    delay *= 2
+                    if pause > 0:
+                        time.sleep(pause)
+                    continue
+                break
             if resp.status != STATUS_OK or len(resp.verdicts) != len(pks):
                 self.rejected[resp.status] = (
                     self.rejected.get(resp.status, 0) + 1
                 )
                 if not self.fallback:
                     raise VerifydRejectedError(resp.status, resp.message)
-                sp.set(outcome=STATUS_NAMES.get(resp.status, "bad"))
+                sp.set(
+                    outcome=STATUS_NAMES.get(resp.status, "bad"),
+                    sheds=sheds,
+                )
                 self.fallback_calls += 1
                 return _host_verify(algo, pks, msgs, sigs)
-            sp.set(outcome="ok")
+            sp.set(outcome="ok", sheds=sheds)
             return list(resp.verdicts)
 
     @property
@@ -303,8 +359,9 @@ class VerifydClient:
 
 _remote_mtx = threading.Lock()
 _remote_addr: str = ""  # config override; env consulted when empty
+_remote_tenant: str = DEFAULT_TENANT  # config override ([ops] verify_tenant)
 _remote_client: Optional[VerifydClient] = None
-_remote_client_addr: str = ""
+_remote_client_key: tuple = ("", DEFAULT_TENANT)
 
 
 def set_remote_addr(addr: str) -> None:
@@ -316,28 +373,38 @@ def set_remote_addr(addr: str) -> None:
         _remote_addr = addr or ""
 
 
+def set_remote_tenant(tenant: str) -> None:
+    """Tenant/chain namespace this node's remote traffic rides under
+    (node assembly plumbs ``[ops] verify_tenant``; empty = default)."""
+    global _remote_tenant
+    with _remote_mtx:
+        _remote_tenant = tenant or DEFAULT_TENANT
+
+
 def reset_remote() -> None:
-    """Drop the override AND the cached client (tests)."""
-    global _remote_addr, _remote_client, _remote_client_addr
+    """Drop the overrides AND the cached client (tests)."""
+    global _remote_addr, _remote_tenant, _remote_client, _remote_client_key
     with _remote_mtx:
         _remote_addr = ""
+        _remote_tenant = DEFAULT_TENANT
         if _remote_client is not None:
             _remote_client.close()
         _remote_client = None
-        _remote_client_addr = ""
+        _remote_client_key = ("", DEFAULT_TENANT)
 
 
 def remote_backend() -> Optional[Callable[..., List[bool]]]:
     """The configured remote's verify_fn, or None. The client is cached
-    process-wide and rebuilt when the address changes."""
-    global _remote_client, _remote_client_addr
+    process-wide and rebuilt when the address or tenant changes."""
+    global _remote_client, _remote_client_key
     with _remote_mtx:
         addr = _remote_addr or os.environ.get(REMOTE_ENV, "")
         if not addr:
             return None
-        if _remote_client is None or _remote_client_addr != addr:
+        key = (addr, _remote_tenant)
+        if _remote_client is None or _remote_client_key != key:
             if _remote_client is not None:
                 _remote_client.close()
-            _remote_client = VerifydClient(addr)
-            _remote_client_addr = addr
+            _remote_client = VerifydClient(addr, tenant=_remote_tenant)
+            _remote_client_key = key
         return _remote_client.verify

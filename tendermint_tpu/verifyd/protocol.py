@@ -15,6 +15,12 @@ Request (proto wire form):
                           not absolute — so no clock sync is assumed
     4  algo      varint   ed25519 | sr25519
     5  lanes     repeated message { 1 pk, 2 msg, 3 sig }
+    6  tenant    string   chain/tenant namespace; OMITTED when it equals
+                          the default tenant (proto3 zero-omission: an
+                          old client that never sets it emits frames
+                          byte-identical to before the field existed,
+                          and the decoder maps absence back to
+                          DEFAULT_TENANT)
 
 Response:
     1  status       varint   OK | RESOURCE_EXHAUSTED | DEADLINE_EXCEEDED
@@ -91,6 +97,13 @@ SIG_SIZE = 64
 MAX_LANES = 4096  # hard per-request cap; larger batches split client-side
 MAX_MSG_SIZE = 1 << 20  # 1 MiB per lane message
 
+# tenant namespace: pre-tenant clients never send field 6, so the
+# decoder must map absence to this — and the encoder must OMIT it when
+# it equals this, or old servers would see an unknown field where old
+# clients sent none (the zero-omission symmetry tpulint TPW004 pins).
+DEFAULT_TENANT = "default"
+MAX_TENANT_LEN = 64  # wire-level cap; the server additionally hashes/caps
+
 
 @dataclass
 class VerifyRequest:
@@ -101,6 +114,7 @@ class VerifyRequest:
     pks: List[bytes] = field(default_factory=list)
     msgs: List[bytes] = field(default_factory=list)
     sigs: List[bytes] = field(default_factory=list)
+    tenant: str = DEFAULT_TENANT
 
     def __len__(self) -> int:
         return len(self.pks)
@@ -136,6 +150,8 @@ def encode_request(req: VerifyRequest) -> bytes:
         out += encode_varint_field(4, req.algo)
     for pk, msg, sig in zip(req.pks, req.msgs, req.sigs):
         out += encode_bytes_field(5, _encode_lane(pk, msg, sig))
+    if req.tenant and req.tenant != DEFAULT_TENANT:
+        out += encode_string_field(6, req.tenant)
     return bytes(out)
 
 
@@ -171,12 +187,19 @@ def decode_request(data: bytes) -> VerifyRequest:
                 req.pks.append(pk)
                 req.msgs.append(msg)
                 req.sigs.append(sig)
+            elif fld == 6 and wire == WIRE_BYTES:
+                req.tenant = r.read_bytes().decode("utf-8", "replace")
             else:
                 r.skip(wire)
     except ValueError:
         raise
     except Exception as exc:  # torn varints etc. from the Reader
         raise ValueError(f"malformed request: {exc}") from exc
+    # absence (old client) and the empty string both mean the default
+    # tenant — re-establishing the encoder's omitted constant (TPW004)
+    req.tenant = req.tenant or DEFAULT_TENANT
+    if len(req.tenant) > MAX_TENANT_LEN:
+        raise ValueError(f"tenant name too long: {len(req.tenant)}")
     if req.kind not in KIND_NAMES:
         raise ValueError(f"unknown kind {req.kind}")
     if req.klass not in CLASS_NAMES:

@@ -1,4 +1,4 @@
-"""verifyd server: one shared scheduler, many client connections.
+"""verifyd server: one shared scheduler, many tenants, many connections.
 
 The daemon owns the accelerator and serves batched verification over
 the zero-dependency gRPC transport. Every connection's lanes funnel
@@ -6,31 +6,60 @@ into ONE ``VerifyScheduler`` per algorithm, so batches form ACROSS
 clients — a lone light client's header check rides the same device
 launch as a validator's commit flood. Scheduling behavior:
 
+- continuous batching: the scheduler's dispatch workers overlap batch
+  prep with the in-flight kernel (``crypto/scheduler.py``), so newly
+  arrived lanes join the NEXT dispatch instead of waiting behind a
+  flush barrier; ``verifyd_dispatch_occupancy`` observes the pipeline
+  depth at every hand-off;
 - deadline-aware flush: each lane carries ``flush_by`` derived from the
   request's wire deadline (minus a respond margin), so the accumulator
   flushes early rather than letting a lane's deadline expire in queue;
 - priority-ordered dequeue: when more lanes are pending than one batch
   holds, consensus < blocksync < light/rpc decides who flushes first;
+- multi-tenant namespaces: requests carry a tenant/chain id
+  (``protocol`` field 6; absent = ``default``). Admission budgets,
+  resident-table pin quotas, and ``tendermint_verifyd_*{tenant=...}``
+  metrics are kept per tenant, so one chain's spike exhausts its own
+  budget, not the fleet's. Label cardinality is bounded: at most
+  ``max_tenants`` distinct labels; later tenants collapse into
+  ``other`` (one shared budget bucket);
 - admission control: ``light``/``rpc`` requests are shed with an
   explicit RESOURCE_EXHAUSTED response — never a silent drop — when
-  queue depth or estimated service time exceeds budget.
-  ``consensus``/``blocksync`` are never shed (losing them stalls the
-  chain, not just a reader); they land in the scheduler's own
-  ``max_pending`` backstop instead.
+  the tenant budget, queue depth, or estimated service time exceeds
+  budget. ``consensus``/``blocksync`` are never shed by admission
+  (losing them stalls the chain, not just a reader); they land in the
+  scheduler's own ``max_pending`` backstop instead.
 
-The verify path under the scheduler is the existing stack: tiered
-host/device dispatch, device health state machine, and the validator
-precompute cache all apply unchanged.
+Brownout ladder (the documented degradation contract, see README):
+under SUSTAINED overload — or device COOLDOWN — the server walks an
+explicit ladder, one rung per ``escalate_after`` of continuous
+pressure, back down one rung per ``recover_after`` of calm:
+
+    0 normal          everything admitted (per-tenant budgets apply)
+    1 shed_rpc        rpc requests shed (brownout)
+    2 shed_light      + light shed
+    3 shed_blocksync  + blocksync shed
+    4 shrink_shares   per-tenant budgets shrink to 1/4; consensus past
+                      a tenant's shrunken dispatch share verifies on
+                      the HOST oracle instead of the device
+    5 host_consensus  ALL consensus verifies host-direct (the device is
+                      out of the loop, e.g. COOLDOWN); everything else
+                      sheds
+
+Consensus is NEVER shed at any rung — its worst case is the host
+oracle, which is slower but sound (same ZIP-215 ground truth).
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from tendermint_tpu.crypto import batch as crypto_batch
 from tendermint_tpu.crypto.scheduler import (
+    DEFAULT_PIPELINE_DEPTH,
     SchedulerSaturatedError,
     VerifyScheduler,
     default_max_batch,
@@ -42,7 +71,12 @@ from tendermint_tpu.verifyd import protocol
 from tendermint_tpu.verifyd.protocol import (
     ALGO_ED25519,
     ALGO_SR25519,
+    CLASS_BLOCKSYNC,
+    CLASS_CONSENSUS,
+    CLASS_LIGHT,
     CLASS_NAMES,
+    CLASS_RPC,
+    DEFAULT_TENANT,
     KIND_NAMES,
     SHEDDABLE_CLASSES,
     STATUS_DEADLINE_EXCEEDED,
@@ -58,7 +92,179 @@ DEFAULT_ADMISSION_CAP = 1024  # pending-lane ceiling for sheddable classes
 DEFAULT_MAX_PENDING = 4096  # hard scheduler cap (all classes)
 DEFAULT_SERVICE_BUDGET = 0.5  # seconds of estimated queue service time
 DEFAULT_WAIT = 10.0  # verdict wait for requests without a deadline
+DEFAULT_TENANT_CAP = 512  # outstanding sheddable lanes per tenant
+DEFAULT_PIN_QUOTA = 256  # resident-table pins per tenant
+DEFAULT_MAX_TENANTS = 16  # distinct tenant label/budget buckets
 _EWMA_ALPHA = 0.2
+_SHRINK_DIVISOR = 4  # tenant share divisor at the shrink_shares rung
+
+# --- brownout ladder ---------------------------------------------------------
+
+LEVEL_NORMAL = 0
+LEVEL_SHED_RPC = 1
+LEVEL_SHED_LIGHT = 2
+LEVEL_SHED_BLOCKSYNC = 3
+LEVEL_SHRINK_SHARES = 4
+LEVEL_HOST_CONSENSUS = 5
+LEVEL_NAMES = {
+    LEVEL_NORMAL: "normal",
+    LEVEL_SHED_RPC: "shed_rpc",
+    LEVEL_SHED_LIGHT: "shed_light",
+    LEVEL_SHED_BLOCKSYNC: "shed_blocksync",
+    LEVEL_SHRINK_SHARES: "shrink_shares",
+    LEVEL_HOST_CONSENSUS: "host_consensus",
+}
+# the declared shed order: rpc first, light next, blocksync last;
+# consensus has NO entry — no rung ever sheds it
+_CLASS_SHED_LEVEL = {
+    CLASS_RPC: LEVEL_SHED_RPC,
+    CLASS_LIGHT: LEVEL_SHED_LIGHT,
+    CLASS_BLOCKSYNC: LEVEL_SHED_BLOCKSYNC,
+}
+
+
+def level_sheds_class(level: int, klass: int) -> bool:
+    """True when the ladder rung ``level`` sheds priority class
+    ``klass``. Consensus is never shed at any level."""
+    at = _CLASS_SHED_LEVEL.get(klass)
+    return at is not None and level >= at
+
+
+def _device_cooling() -> bool:
+    """Process-wide device health says the accelerator is cooling down
+    (or terminally disabled): pin the ladder at host_consensus."""
+    try:
+        from tendermint_tpu.ops.device_policy import (
+            COOLDOWN,
+            DISABLED,
+            shared,
+        )
+
+        return shared.state in (COOLDOWN, DISABLED)
+    except Exception:
+        # health machinery unavailable (host-only build): never escalate
+        return False
+
+
+class BrownoutController:
+    """Walks the degradation ladder on sustained pressure.
+
+    Fed one boolean load sample per request (``observe``): pressure
+    sustained for ``escalate_after`` seconds climbs one rung (and
+    restarts the clock); calm sustained for ``recover_after`` descends
+    one. ``cooldown_fn`` (default: the process-wide device health
+    machine) pins the EFFECTIVE level at host_consensus while the
+    device is in COOLDOWN/DISABLED, regardless of load. ``force``
+    overrides the level outright (tests, operator override).
+    """
+
+    def __init__(
+        self,
+        escalate_after: float = 0.25,
+        recover_after: float = 1.0,
+        cooldown_fn: Optional[Callable[[], bool]] = _device_cooling,
+    ):
+        self.escalate_after = escalate_after
+        self.recover_after = recover_after
+        self._cooldown_fn = cooldown_fn
+        self._mtx = threading.Lock()
+        self._level = LEVEL_NORMAL  # guarded-by: _mtx
+        self._forced: Optional[int] = None  # guarded-by: _mtx
+        self._pressure_since: Optional[float] = None  # guarded-by: _mtx
+        self._calm_since: Optional[float] = None  # guarded-by: _mtx
+        self.transitions = {"up": 0, "down": 0}  # guarded-by: _mtx
+
+    def force(self, level: Optional[int]) -> None:
+        """Pin the effective level (None releases the pin)."""
+        with self._mtx:
+            self._forced = level
+
+    @property
+    def level(self) -> int:
+        """The organic (load-driven) level, ignoring force/cooldown."""
+        with self._mtx:
+            return self._level
+
+    def effective(self) -> int:
+        with self._mtx:
+            return self._effective_locked()
+
+    def _effective_locked(self) -> int:
+        lvl = self._level if self._forced is None else self._forced
+        if self._cooldown_fn is not None:
+            try:
+                cooling = self._cooldown_fn()
+            except Exception:
+                cooling = False  # a broken probe must not change policy
+            if cooling:
+                lvl = max(lvl, LEVEL_HOST_CONSENSUS)
+        return lvl
+
+    def observe(
+        self, pressure: bool, now: Optional[float] = None
+    ) -> Tuple[int, int]:
+        """Feed one load sample; returns ``(effective_level, delta)``
+        where delta is +1/-1 when this sample moved the organic level."""
+        now = time.monotonic() if now is None else now
+        delta = 0
+        with self._mtx:
+            if pressure:
+                self._calm_since = None
+                if self._pressure_since is None:
+                    self._pressure_since = now
+                elif (
+                    now - self._pressure_since >= self.escalate_after
+                    and self._level < LEVEL_HOST_CONSENSUS
+                ):
+                    self._level += 1
+                    self.transitions["up"] += 1
+                    self._pressure_since = now
+                    delta = 1
+            else:
+                self._pressure_since = None
+                if self._level == LEVEL_NORMAL:
+                    self._calm_since = None
+                elif self._calm_since is None:
+                    self._calm_since = now
+                elif now - self._calm_since >= self.recover_after:
+                    self._level -= 1
+                    self.transitions["down"] += 1
+                    self._calm_since = now
+                    delta = -1
+            return self._effective_locked(), delta
+
+
+# --- tenants -----------------------------------------------------------------
+
+TENANT_OVERFLOW_LABEL = "other"
+
+
+def sanitize_tenant_label(name: str) -> str:
+    """Metrics-safe tenant label: alnum/dash/underscore/dot, max 32
+    chars. Names that don't survive sanitization intact become a stable
+    hash so distinct ugly ids don't collide with each other."""
+    safe = "".join(c for c in name if c.isalnum() or c in "-_.")[:32]
+    if safe == name and safe:
+        return safe
+    return "t" + hashlib.sha1(name.encode("utf-8")).hexdigest()[:8]
+
+
+class _TenantState:
+    """Per-tenant accounting. All fields guarded by the server's
+    ``_tenant_mtx`` (one lock for the whole registry: tenant counts are
+    bounded and the critical sections are tiny)."""
+
+    __slots__ = ("label", "depth", "lanes", "sheds", "host_direct")
+
+    def __init__(self, label: str):
+        self.label = label
+        self.depth = 0  # outstanding (admitted, unresolved) lanes
+        self.lanes = 0  # total lanes admitted
+        self.sheds = 0  # total requests shed
+        self.host_direct = 0  # lanes verified on the host oracle
+
+
+# --- admission ---------------------------------------------------------------
 
 
 def _default_sr25519_verify(pks, msgs, sigs) -> List[bool]:
@@ -79,7 +285,7 @@ def _host_sr25519_verify(pks, msgs, sigs) -> List[bool]:
 class AdmissionController:
     """Sheds sheddable-class load when the queue is past budget.
 
-    Two trip-wires, both checked at enqueue time: pending depth past
+    Two trip-wires, both checked at enqueue time: unresolved depth past
     ``cap`` lanes, or estimated service time for the queue (EWMA
     per-lane flush cost x depth) past ``service_budget`` seconds. The
     estimate learns from real flushes via ``observe_flush``.
@@ -108,6 +314,13 @@ class AdmissionController:
     def estimated_service_time(self, depth: int) -> float:
         with self._mtx:
             return depth * self._lane_ewma
+
+    def pressure(self, depth: int) -> bool:
+        """Load sample for the brownout controller: is the queue past
+        either budget right now?"""
+        if depth > self.cap:
+            return True
+        return self.estimated_service_time(depth) > self.service_budget
 
     def admit(self, klass: int, lanes: int, depth: int) -> Optional[str]:
         """None = admitted; else the shed reason. Only sheddable
@@ -138,10 +351,20 @@ class VerifydServer:
         sr25519_verify_fn: Optional[Callable[..., List[bool]]] = None,
         metrics: Optional[VerifydMetrics] = None,
         evloop_metrics=None,
+        continuous: Optional[bool] = None,
+        pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+        tenant_cap: int = DEFAULT_TENANT_CAP,
+        tenant_pin_quota: int = DEFAULT_PIN_QUOTA,
+        max_tenants: int = DEFAULT_MAX_TENANTS,
+        brownout: Optional[BrownoutController] = None,
     ):
         self.metrics = metrics or VerifydMetrics.nop()
         self.max_delay = max_delay
         self.admission = AdmissionController(admission_cap, service_budget)
+        self.brownout = brownout or BrownoutController()
+        self.tenant_cap = tenant_cap
+        self.tenant_pin_quota = tenant_pin_quota
+        self.max_tenants = max(1, max_tenants)
         self._verify_fns = {
             ALGO_ED25519: (
                 verify_fn or crypto_batch.tiered_verify_ed25519,
@@ -158,14 +381,18 @@ class VerifydServer:
             max_batch=default_max_batch() if max_batch is None else max_batch,
             max_delay=max_delay,
             max_pending=max_pending,
+            continuous=continuous,
+            pipeline_depth=pipeline_depth,
         )
         self._schedulers: Dict[int, VerifyScheduler] = {}  # guarded-by: _sched_mtx
         self._sched_mtx = threading.Lock()
         self._depth_mtx = threading.Lock()
         self._class_depth: Dict[int, int] = {}  # guarded-by: _depth_mtx
+        self._tenant_mtx = threading.Lock()
+        self._tenants: Dict[str, _TenantState] = {}  # guarded-by: _tenant_mtx
         # plain counters for tests and bench (metrics-free introspection).
-        # Handler threads and both schedulers' accumulator threads all
-        # write these, so they take their own mutex.
+        # Handler threads and the schedulers' dispatch threads all write
+        # these, so they take their own mutex.
         self._stats_mtx = threading.Lock()
         self.cross_client_flushes: Dict[str, int] = {
             "size": 0, "deadline": 0, "shutdown": 0,
@@ -173,6 +400,7 @@ class VerifydServer:
         self.admission_rejections = 0  # guarded-by: _stats_mtx
         self.deadline_expired = 0  # guarded-by: _stats_mtx
         self.requests_served = 0  # guarded-by: _stats_mtx
+        self.host_direct_lanes = 0  # guarded-by: _stats_mtx
         self._grpc = GrpcServer(
             {VERIFY_PATH: self._handle}, host, port,
             evloop_metrics=evloop_metrics,
@@ -218,13 +446,81 @@ class VerifydServer:
                             self._on_flush(reason, batch, seconds, _algo)
                         )
                     ),
+                    on_dispatch=self._on_dispatch,
                     **self._sched_args,
                 )
                 sched.start()
                 self._schedulers[algo] = sched
             return sched
 
-    # --- flush observer -----------------------------------------------------
+    # --- tenants ------------------------------------------------------------
+
+    def _tenant_for(self, name: str) -> _TenantState:
+        """Registry lookup with bounded cardinality: once
+        ``max_tenants`` distinct states exist, every UNSEEN tenant maps
+        to one shared ``other`` bucket (label and budget both)."""
+        with self._tenant_mtx:
+            ts = self._tenants.get(name)
+            if ts is not None:
+                return ts
+            distinct = len(set(id(t) for t in self._tenants.values()))
+            if distinct >= self.max_tenants:
+                ts = self._tenants.get(TENANT_OVERFLOW_LABEL)
+                if ts is None:
+                    ts = _TenantState(TENANT_OVERFLOW_LABEL)
+                    self._tenants[TENANT_OVERFLOW_LABEL] = ts
+            else:
+                ts = _TenantState(sanitize_tenant_label(name))
+            self._tenants[name] = ts
+            return ts
+
+    def tenant_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-label accounting snapshot (bench/chaos introspection)."""
+        out: Dict[str, Dict[str, int]] = {}
+        with self._tenant_mtx:
+            for ts in self._tenants.values():
+                if ts.label not in out:
+                    out[ts.label] = {
+                        "depth": ts.depth,
+                        "lanes": ts.lanes,
+                        "sheds": ts.sheds,
+                        "host_direct": ts.host_direct,
+                    }
+        return out
+
+    def _tenant_shed(self, ts: _TenantState, reason: str) -> None:
+        with self._tenant_mtx:
+            ts.sheds += 1
+        self.metrics.tenant_rejections.labels(
+            tenant=ts.label, reason=reason
+        ).inc()
+
+    def _tenant_admit(self, ts: _TenantState, n: int) -> None:
+        with self._tenant_mtx:
+            ts.depth += n
+            ts.lanes += n
+            depth = ts.depth
+        self.metrics.tenant_lanes.labels(tenant=ts.label).inc(n)
+        self.metrics.tenant_queue_depth.labels(tenant=ts.label).set(depth)
+
+    def _tenant_release(self, ts: _TenantState, n: int) -> None:
+        with self._tenant_mtx:
+            ts.depth = max(0, ts.depth - n)
+            depth = ts.depth
+        self.metrics.tenant_queue_depth.labels(tenant=ts.label).set(depth)
+
+    def _tenant_budget(self, level: int) -> int:
+        """Effective per-tenant outstanding-lane budget at this rung."""
+        if level >= LEVEL_SHRINK_SHARES:
+            return max(1, self.tenant_cap // _SHRINK_DIVISOR)
+        return self.tenant_cap
+
+    # --- flush / dispatch observers -----------------------------------------
+
+    def _on_dispatch(self, depth: int, lanes: int, reason: str) -> None:
+        """Scheduler hand-off hook: depth = outstanding dispatches
+        (queued + in flight) — the continuous-batching occupancy."""
+        self.metrics.dispatch_occupancy.observe(depth)
 
     def _on_flush(
         self, reason: str, batch: list, seconds: float, algo: int = ALGO_ED25519
@@ -236,12 +532,22 @@ class VerifydServer:
         if algo == ALGO_ED25519:
             # Repeat signers from set-less verifyd traffic feed the
             # device-resident table store's hot-key pinning
-            # (ops/resident.py); the import stays lazy + guarded so a
-            # host-only daemon config never pays for the ops engine.
+            # (ops/resident.py), capped per tenant so one chain's
+            # validator universe can't evict everyone else's; the
+            # import stays lazy + guarded so a host-only daemon config
+            # never pays for the ops engine.
             try:
                 from tendermint_tpu.ops import resident
 
-                resident.note_hot_keys(p.pubkey for p in batch)
+                by_tenant: Dict[Optional[str], list] = {}
+                for p in batch:
+                    by_tenant.setdefault(p.tenant, []).append(p.pubkey)
+                for tname, pks in by_tenant.items():
+                    resident.note_hot_keys(
+                        pks,
+                        tenant=tname or DEFAULT_TENANT,
+                        quota=self.tenant_pin_quota,
+                    )
             except Exception:
                 # accounting hook only — a broken ops import must never
                 # touch the serving path
@@ -273,6 +579,7 @@ class VerifydServer:
         t0: float,
         kind_name: str,
         queue_depth: int = 0,
+        tenant_label: str = "",
     ) -> bytes:
         with tracing.span("verifyd_respond", status=STATUS_NAMES[status]):
             with self._stats_mtx:
@@ -283,6 +590,10 @@ class VerifydServer:
             self.metrics.request_seconds.labels(kind=kind_name).observe(
                 time.monotonic() - t0
             )
+            if tenant_label:
+                self.metrics.tenant_request_seconds.labels(
+                    tenant=tenant_label
+                ).observe(time.monotonic() - t0)
             return protocol.encode_response(
                 protocol.VerifyResponse(
                     status=status,
@@ -291,6 +602,71 @@ class VerifydServer:
                     queue_depth=queue_depth,
                 )
             )
+
+    def _shed(
+        self,
+        ts: _TenantState,
+        klass_name: str,
+        reason: str,
+        n: int,
+        message: str,
+        t0: float,
+        kind_name: str,
+        depth: int,
+    ) -> bytes:
+        """Every shed path funnels here: explicit RESOURCE_EXHAUSTED on
+        the wire, a reasoned rejection metric per class AND per tenant —
+        never a silent drop."""
+        with self._stats_mtx:
+            self.admission_rejections += 1
+        self._tenant_shed(ts, reason)
+        self.metrics.admission_rejections.labels(
+            klass=klass_name, reason=reason
+        ).inc()
+        tracing.instant(
+            "verifyd_shed",
+            klass=klass_name,
+            reason=reason,
+            lanes=n,
+            tenant=ts.label,
+        )
+        return self._respond(
+            STATUS_RESOURCE_EXHAUSTED,
+            [],
+            message,
+            t0,
+            kind_name,
+            depth,
+            tenant_label=ts.label,
+        )
+
+    def _host_direct(
+        self,
+        req,
+        ts: _TenantState,
+        t0: float,
+        kind_name: str,
+        level: int,
+    ) -> bytes:
+        """host_consensus rung: consensus lanes bypass the device
+        scheduler and verify on the host oracle — slower, sound, and
+        immune to whatever took the device out."""
+        n = len(req)
+        _verify_fn, host_fn = self._verify_fns[req.algo]
+        with tracing.span(
+            "verifyd_host_direct", lanes=n, tenant=ts.label, level=level
+        ):
+            verdicts = list(host_fn(req.pks, req.msgs, req.sigs))
+        with self._stats_mtx:
+            self.host_direct_lanes += n
+        with self._tenant_mtx:
+            ts.host_direct += n
+            ts.lanes += n
+        self.metrics.host_direct_lanes.inc(n)
+        self.metrics.tenant_lanes.labels(tenant=ts.label).inc(n)
+        return self._respond(
+            STATUS_OK, verdicts, "", t0, kind_name, 0, tenant_label=ts.label
+        )
 
     def _handle(self, payload: bytes) -> bytes:
         t0 = time.monotonic()
@@ -305,30 +681,77 @@ class VerifydServer:
                     )
             kind_name = KIND_NAMES[req.kind]
             klass_name = CLASS_NAMES[req.klass]
+            ts = self._tenant_for(req.tenant)
             n = len(req)
             if n == 0:
-                return self._respond(STATUS_OK, [], "", t0, kind_name)
+                return self._respond(
+                    STATUS_OK, [], "", t0, kind_name, tenant_label=ts.label
+                )
             sched = self._scheduler_for(req.algo)
             deadline_s = req.deadline_ms / 1000.0 if req.deadline_ms else 0.0
 
-            depth = sched.pending_depth()
-            shed = self.admission.admit(req.klass, n, depth)
-            if shed is not None:
-                with self._stats_mtx:
-                    self.admission_rejections += 1
-                self.metrics.admission_rejections.labels(
-                    klass=klass_name, reason=shed
+            # load_depth counts in-flight lanes too: on the continuous
+            # path lanes leave the accumulator while their dispatch
+            # still occupies the device, and admission must see them
+            depth = sched.load_depth()
+            level, moved = self.brownout.observe(
+                self.admission.pressure(depth)
+            )
+            self.metrics.brownout_level.set(level)
+            if moved:
+                direction = "up" if moved > 0 else "down"
+                self.metrics.brownout_transitions.labels(
+                    direction=direction
                 ).inc()
                 tracing.instant(
-                    "verifyd_shed", klass=klass_name, reason=shed, lanes=n
+                    "verifyd_brownout",
+                    level=LEVEL_NAMES[level],
+                    direction=direction,
                 )
-                return self._respond(
-                    STATUS_RESOURCE_EXHAUSTED,
-                    [],
+
+            # ladder rungs 1-3: whole-class sheds (rpc -> light ->
+            # blocksync; consensus never)
+            if level_sheds_class(level, req.klass):
+                return self._shed(
+                    ts, klass_name, "brownout", n,
+                    f"{klass_name} shed (brownout {LEVEL_NAMES[level]})",
+                    t0, kind_name, depth,
+                )
+            # ladder rung 5: device out of the loop — consensus goes
+            # host-direct (rung 3 already shed everything else)
+            if level >= LEVEL_HOST_CONSENSUS and req.klass == CLASS_CONSENSUS:
+                return self._host_direct(req, ts, t0, kind_name, level)
+
+            # per-tenant budget: all-or-nothing for the WHOLE request —
+            # an atomic lane group never splits on the budget boundary
+            budget = self._tenant_budget(level)
+            if req.klass in SHEDDABLE_CLASSES:
+                with self._tenant_mtx:
+                    over = ts.depth + n > budget
+                if over:
+                    return self._shed(
+                        ts, klass_name, "tenant_budget", n,
+                        f"tenant {ts.label} over budget ({budget} lanes)",
+                        t0, kind_name, depth,
+                    )
+            elif (
+                level >= LEVEL_SHRINK_SHARES
+                and req.klass == CLASS_CONSENSUS
+            ):
+                # shrink_shares rung: consensus past the tenant's
+                # shrunken dispatch share rides the host oracle instead
+                # of the device — never shed, never silently dropped
+                with self._tenant_mtx:
+                    over = ts.depth + n > budget
+                if over:
+                    return self._host_direct(req, ts, t0, kind_name, level)
+
+            shed = self.admission.admit(req.klass, n, depth)
+            if shed is not None:
+                return self._shed(
+                    ts, klass_name, shed, n,
                     f"{klass_name} load shed ({shed}, {depth} pending)",
-                    t0,
-                    kind_name,
-                    depth,
+                    t0, kind_name, depth,
                 )
 
             # enqueue: the wire deadline (minus a respond margin) becomes
@@ -343,37 +766,28 @@ class VerifydServer:
             # so the transport's per-connection tag is authoritative;
             # the thread ident covers direct (non-gRPC) handler calls.
             tag = current_conn_tag(threading.get_ident())
-            entries = []
             try:
                 with tracing.span(
-                    "verifyd_enqueue", lanes=n, klass=klass_name
+                    "verifyd_enqueue", lanes=n, klass=klass_name,
+                    tenant=ts.label,
                 ):
-                    for pk, msg, sig in zip(req.pks, req.msgs, req.sigs):
-                        entries.append(
-                            sched.submit(
-                                pk,
-                                msg,
-                                sig,
-                                priority=req.klass,
-                                flush_by=flush_by,
-                                tag=tag,
-                            )
-                        )
+                    # submit_many is atomic against max_pending: the
+                    # group lands whole or not at all, even while the
+                    # continuous dispatcher is draining concurrently
+                    entries = sched.submit_many(
+                        list(zip(req.pks, req.msgs, req.sigs)),
+                        priority=req.klass,
+                        flush_by=flush_by,
+                        tag=tag,
+                        tenant=ts.label,
+                    )
             except SchedulerSaturatedError as exc:
-                # lanes submitted before saturation still flush; their
-                # verdicts are simply unread (rare, bounded waste)
-                self.metrics.admission_rejections.labels(
-                    klass=klass_name, reason="saturated"
-                ).inc()
-                return self._respond(
-                    STATUS_RESOURCE_EXHAUSTED,
-                    [],
-                    str(exc),
-                    t0,
-                    kind_name,
-                    sched.pending_depth(),
+                return self._shed(
+                    ts, klass_name, "saturated", n,
+                    str(exc), t0, kind_name, sched.pending_depth(),
                 )
             self._track_depth(req.klass, n)
+            self._tenant_admit(ts, n)
             self.metrics.lanes.labels(klass=klass_name).inc(n)
 
             try:
@@ -393,6 +807,7 @@ class VerifydServer:
                                     t0,
                                     kind_name,
                                     sched.pending_depth(),
+                                    tenant_label=ts.label,
                                 )
                             verdicts.append(entry.ok)
                         else:
@@ -401,8 +816,10 @@ class VerifydServer:
                             )
             finally:
                 self._track_depth(req.klass, -n)
+                self._tenant_release(ts, n)
             return self._respond(
-                STATUS_OK, verdicts, "", t0, kind_name, sched.pending_depth()
+                STATUS_OK, verdicts, "", t0, kind_name,
+                sched.pending_depth(), tenant_label=ts.label,
             )
         except Exception as exc:  # never tear the stream on a handler bug
             return self._respond(

@@ -484,6 +484,80 @@ class TestWireCompat:
     def test_non_wire_files_ignored(self):
         assert run_on(WireCompatChecker(), {"other.py": WIRE_DIRTY}) == []
 
+    def test_default_omitted_string_without_reestablish(self):
+        src = """
+            DEFAULT_TENANT = "default"
+
+            def encode_string_field(field, s):
+                return b""
+
+            def encode(req):
+                out = b""
+                if req.tenant and req.tenant != DEFAULT_TENANT:
+                    out += encode_string_field(6, req.tenant)
+                return out
+
+            def decode(r, req):
+                req.tenant = r.read_bytes().decode()
+                return req
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPW004"]
+        assert "tenant" in found[0].message
+        assert "DEFAULT_TENANT" in found[0].message
+
+    def test_default_omitted_string_with_or_normalization_passes(self):
+        src = """
+            DEFAULT_TENANT = "default"
+
+            def encode_string_field(field, s):
+                return b""
+
+            def encode(req):
+                out = b""
+                if req.tenant and req.tenant != DEFAULT_TENANT:
+                    out += encode_string_field(6, req.tenant)
+                return out
+
+            def decode(r, req):
+                req.tenant = r.read_bytes().decode()
+                req.tenant = req.tenant or DEFAULT_TENANT
+                return req
+        """
+        assert self.run(src) == []
+
+    def test_default_omitted_string_with_dataclass_default_passes(self):
+        src = """
+            DEFAULT_TENANT = "default"
+
+            class VerifyRequest:
+                tenant: str = DEFAULT_TENANT
+
+            def encode_string_field(field, s):
+                return b""
+
+            def encode(req):
+                out = b""
+                if req.tenant != DEFAULT_TENANT:
+                    out += encode_string_field(6, req.tenant)
+                return out
+        """
+        assert self.run(src) == []
+
+    def test_truthiness_only_string_omission_passes(self):
+        # omit-when-empty round-trips (decode default IS ""): not TPW004
+        src = """
+            def encode_string_field(field, s):
+                return b""
+
+            def encode(resp):
+                out = b""
+                if resp.message:
+                    out += encode_string_field(3, resp.message)
+                return out
+        """
+        assert self.run(src) == []
+
 
 # --- hygiene -----------------------------------------------------------------
 

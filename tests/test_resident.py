@@ -237,3 +237,21 @@ def test_hot_keys_promote_to_pinned():
     resident.note_hot_keys(pks)  # threshold 2 -> pin
     entries, has_table = precompute.tables.gather(pks)
     assert entries is not None and has_table.all()
+
+
+def test_tenant_pin_quota_caps_one_namespace():
+    """A tenant over its pin quota stops accumulating pins (counted as
+    denials), while other tenants keep their full quota."""
+    a_pks, _, _ = _batch(3, seed=160)
+    b_pks, _, _ = _batch(2, seed=170)
+    for _ in range(2):  # threshold 2 -> pin attempts
+        resident.note_hot_keys(a_pks, tenant="chain-a", quota=2)
+    for _ in range(2):
+        resident.note_hot_keys(b_pks, tenant="chain-b", quota=2)
+    pins = resident.store.tenant_pins()
+    assert pins["chain-a"] == 2  # third key denied at the quota
+    assert pins["chain-b"] == 2  # isolated: unaffected by a's denial
+    assert resident.stats()["pin_quota_denials"] >= 1
+    # the denied key was NOT pinned: only a's first two made the store
+    _, has_table = precompute.tables.gather(a_pks)
+    assert has_table[:2].all() and not has_table[2]

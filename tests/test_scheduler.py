@@ -146,6 +146,191 @@ class TestBatching:
             s.stop()
 
 
+class TestContinuousBatching:
+    def test_admits_lanes_while_dispatch_in_flight(self):
+        """The tentpole property: lanes submitted while a kernel runs
+        join the NEXT dispatch instead of waiting out a flush barrier."""
+        release = threading.Event()
+        entered = threading.Event()
+        calls = []
+
+        def gated_verify(pks, msgs, sigs):
+            calls.append(len(pks))
+            if len(calls) == 1:
+                entered.set()
+                release.wait(timeout=10)
+            return host_verify(pks, msgs, sigs)
+
+        s = VerifyScheduler(
+            gated_verify, max_batch=4, max_delay=0.01,
+            continuous=True, pipeline_depth=2,
+        )
+        s.start()
+        try:
+            first = [s.submit(*_signed(i)) for i in range(4)]  # size flush
+            assert entered.wait(timeout=5)  # dispatch 1 is on the device
+            # submit while in flight: these must be admitted, counted,
+            # and dispatched without waiting for dispatch 1 to return
+            second = [s.submit(*_signed(4 + i)) for i in range(4)]
+            deadline = time.monotonic() + 5
+            while s.dispatch_handoffs < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert s.dispatch_handoffs >= 2
+            assert s.inflight_admissions >= 1
+            # the second batch resolves while the first is STILL blocked
+            assert s.wait_many(second, timeout=5) == [True] * 4
+            assert not first[0].done.is_set()
+            release.set()
+            assert s.wait_many(first, timeout=5) == [True] * 4
+        finally:
+            release.set()
+            s.stop()
+
+    def test_pipeline_depth_bounds_outstanding_dispatches(self):
+        release = threading.Event()
+
+        def gated_verify(pks, msgs, sigs):
+            release.wait(timeout=10)
+            return host_verify(pks, msgs, sigs)
+
+        # size-only flushes (huge deadline): every batch is exactly
+        # max_batch lanes, so the depth arithmetic below is exact
+        s = VerifyScheduler(
+            gated_verify, max_batch=2, max_delay=60.0,
+            continuous=True, pipeline_depth=2,
+        )
+        s.start()
+        try:
+            handles = [s.submit(*_signed(i)) for i in range(8)]
+            deadline = time.monotonic() + 5
+            while s.dispatch_depth() < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            # both slots taken; the rest back-pressures into the
+            # accumulator rather than growing the hand-off queue
+            time.sleep(0.05)
+            assert s.dispatch_depth() == 2
+            assert s.pending_depth() == 4
+            assert s.load_depth() == 8
+            release.set()
+            assert s.wait_many(handles, timeout=10) == [True] * 8
+            assert s.load_depth() == 0
+        finally:
+            release.set()
+            s.stop()
+
+    def test_on_dispatch_reports_occupancy(self):
+        seen = []
+        release = threading.Event()
+
+        def gated_verify(pks, msgs, sigs):
+            release.wait(timeout=10)
+            return host_verify(pks, msgs, sigs)
+
+        s = VerifyScheduler(
+            gated_verify, max_batch=2, max_delay=0.005,
+            continuous=True, pipeline_depth=2,
+            on_dispatch=lambda depth, lanes, reason: seen.append(
+                (depth, lanes, reason)
+            ),
+        )
+        s.start()
+        try:
+            handles = [s.submit(*_signed(i)) for i in range(4)]
+            deadline = time.monotonic() + 5
+            while len(seen) < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            release.set()
+            s.wait_many(handles, timeout=10)
+            assert len(seen) >= 2
+            assert sum(lanes for _, lanes, _ in seen) == 4
+            # with both batches held on the device, a later hand-off
+            # observed occupancy 2 — the pipeline genuinely overlapped
+            assert max(d for d, _, _ in seen) == 2
+        finally:
+            release.set()
+            s.stop()
+
+    def test_barrier_mode_spawns_no_workers(self):
+        s = VerifyScheduler(host_verify, max_batch=8, continuous=False)
+        s.start()
+        try:
+            assert s._workers == []
+            assert s.verify(*_signed(1))
+            assert s.dispatch_handoffs == 0  # flushed inline
+        finally:
+            s.stop()
+
+    def test_submit_many_is_atomic_against_max_pending(self):
+        release = threading.Event()
+
+        def gated_verify(pks, msgs, sigs):
+            release.wait(timeout=10)
+            return host_verify(pks, msgs, sigs)
+
+        s = VerifyScheduler(
+            gated_verify, max_batch=4, max_delay=0.005,
+            max_pending=6, continuous=True, pipeline_depth=1,
+        )
+        s.start()
+        try:
+            first = s.submit_many([_signed(i) for i in range(4)])
+            deadline = time.monotonic() + 5
+            while s.pending_depth() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            filler = s.submit_many([_signed(10 + i) for i in range(4)])
+            # 4 pending of 6: a group of 3 must be rejected WHOLE —
+            # never 2 admitted + 1 shed
+            from tendermint_tpu.crypto.scheduler import (
+                SchedulerSaturatedError,
+            )
+            with pytest.raises(SchedulerSaturatedError):
+                s.submit_many([_signed(20 + i) for i in range(3)])
+            assert s.pending_depth() == 4
+            release.set()
+            assert all(s.wait_many(first + filler, timeout=10))
+            assert s.entries_verified == 8  # nothing from the shed group
+        finally:
+            release.set()
+            s.stop()
+
+    def test_submit_many_groups_race_continuous_dispatcher(self):
+        """Many atomic groups racing the dispatch workers: every group
+        resolves all-or-nothing and no lane is lost or double-counted."""
+        s = VerifyScheduler(
+            host_verify, max_batch=8, max_delay=0.002,
+            max_pending=64, continuous=True, pipeline_depth=2,
+        )
+        s.start()
+        try:
+            outcomes = {}
+
+            def one_group(g):
+                lanes = [_signed((g * 5 + i) % 16) for i in range(5)]
+                try:
+                    handles = s.submit_many(lanes)
+                except Exception:
+                    outcomes[g] = "shed"
+                    return
+                oks = s.wait_many(handles, timeout=10)
+                outcomes[g] = "ok" if all(oks) else "partial"
+
+            threads = [
+                threading.Thread(target=one_group, args=(g,))
+                for g in range(12)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=15)
+            assert len(outcomes) == 12
+            assert "partial" not in outcomes.values()
+            admitted = sum(1 for v in outcomes.values() if v == "ok")
+            assert admitted >= 1
+            assert s.entries_verified == admitted * 5
+        finally:
+            s.stop()
+
+
 class TestFailureModes:
     def test_verifier_exception_fails_closed(self):
         def broken(pks, msgs, sigs):

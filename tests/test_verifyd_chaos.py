@@ -1,0 +1,620 @@
+"""Serving-tier chaos battery for verifyd.
+
+Injects the failure modes a long-lived verification daemon actually
+meets — device faults mid-dispatch, clients dying mid-frame, slow
+readers, tenant floods, a kill/restart under load — and pins the
+degradation contract:
+
+- consensus-class verification NEVER silently drops: its worst case is
+  the host oracle (brownout ladder rung 5), not a loss;
+- every rejected request gets an explicit wire status, never silence;
+- one tenant's flood cannot destroy another tenant's latency: the
+  victim's p99 stays within 3x its unloaded p99 (floored, so a fast
+  machine doesn't make the bound vacuous);
+- continuous batching demonstrably overlaps admission with the
+  in-flight kernel (trace-span containment proves it).
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto.ed25519_ref import verify_zip215
+from tendermint_tpu.crypto.keys import Ed25519PrivKey
+from tendermint_tpu.libs import tracing
+from tendermint_tpu.libs.grpc import PREFACE
+from tendermint_tpu.ops.fault_injection import DeviceFault
+from tendermint_tpu.verifyd import protocol
+from tendermint_tpu.verifyd.client import (
+    VerifydClient,
+    VerifydRejectedError,
+    VerifydUnavailableError,
+)
+from tendermint_tpu.verifyd.server import (
+    LEVEL_HOST_CONSENSUS,
+    LEVEL_NORMAL,
+    LEVEL_SHED_BLOCKSYNC,
+    LEVEL_SHED_LIGHT,
+    LEVEL_SHED_RPC,
+    LEVEL_SHRINK_SHARES,
+    BrownoutController,
+    VerifydServer,
+    level_sheds_class,
+)
+
+
+def host_verify(pks, msgs, sigs):
+    return [verify_zip215(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+
+
+def make_lanes(n, seed=0, bad=()):
+    priv = Ed25519PrivKey.from_seed(bytes([seed] * 32))
+    pk = priv.pub_key().bytes()
+    msgs = [b"chaos-%d-%d" % (seed, i) for i in range(n)]
+    sigs = [
+        bytes(64) if i in bad else priv.sign(m) for i, m in enumerate(msgs)
+    ]
+    return [pk] * n, msgs, sigs
+
+
+# --- ladder semantics (unit) -------------------------------------------------
+
+
+def test_ladder_shed_order_and_consensus_immunity():
+    """rpc sheds first, light second, blocksync last; consensus at NO
+    rung — the declared degradation order, mechanically."""
+    first_shed = {}
+    for klass in (
+        protocol.CLASS_RPC,
+        protocol.CLASS_LIGHT,
+        protocol.CLASS_BLOCKSYNC,
+    ):
+        for level in range(LEVEL_HOST_CONSENSUS + 1):
+            if level_sheds_class(level, klass):
+                first_shed[klass] = level
+                break
+    assert first_shed[protocol.CLASS_RPC] == LEVEL_SHED_RPC
+    assert first_shed[protocol.CLASS_LIGHT] == LEVEL_SHED_LIGHT
+    assert first_shed[protocol.CLASS_BLOCKSYNC] == LEVEL_SHED_BLOCKSYNC
+    assert (
+        first_shed[protocol.CLASS_RPC]
+        < first_shed[protocol.CLASS_LIGHT]
+        < first_shed[protocol.CLASS_BLOCKSYNC]
+    )
+    for level in range(LEVEL_HOST_CONSENSUS + 1):
+        assert not level_sheds_class(level, protocol.CLASS_CONSENSUS)
+
+
+def test_brownout_escalates_on_sustained_pressure_and_recovers():
+    """Synthetic clock: pressure sustained past escalate_after climbs
+    exactly one rung per window; calm descends one per recover_after."""
+    b = BrownoutController(
+        escalate_after=0.1, recover_after=0.2, cooldown_fn=None
+    )
+    assert b.observe(True, now=0.0) == (LEVEL_NORMAL, 0)
+    assert b.observe(True, now=0.05) == (LEVEL_NORMAL, 0)  # not sustained yet
+    assert b.observe(True, now=0.11) == (LEVEL_SHED_RPC, 1)
+    assert b.observe(True, now=0.15) == (LEVEL_SHED_RPC, 0)  # clock restarted
+    assert b.observe(True, now=0.22) == (LEVEL_SHED_LIGHT, 1)
+    # one blip of calm does not recover...
+    assert b.observe(False, now=0.3) == (LEVEL_SHED_LIGHT, 0)
+    # ...sustained calm walks back down one rung per window
+    assert b.observe(False, now=0.51) == (LEVEL_SHED_RPC, -1)
+    assert b.observe(False, now=0.72) == (LEVEL_NORMAL, -1)
+    assert b.observe(False, now=1.0) == (LEVEL_NORMAL, 0)
+    assert b.transitions == {"up": 2, "down": 2}
+
+
+def test_brownout_never_escalates_past_top_rung():
+    b = BrownoutController(escalate_after=0.01, cooldown_fn=None)
+    t = 0.0
+    for _ in range(20):
+        t += 0.02
+        b.observe(True, now=t)
+    assert b.level == LEVEL_HOST_CONSENSUS
+
+
+def test_device_cooldown_pins_host_consensus():
+    cooling = [False]
+    b = BrownoutController(cooldown_fn=lambda: cooling[0])
+    assert b.effective() == LEVEL_NORMAL
+    cooling[0] = True
+    assert b.effective() == LEVEL_HOST_CONSENSUS  # load-independent pin
+    assert b.level == LEVEL_NORMAL  # the organic level is untouched
+    cooling[0] = False
+    assert b.effective() == LEVEL_NORMAL
+
+
+# --- ladder rungs over the wire ----------------------------------------------
+
+
+def _client(addr, **kw):
+    kw.setdefault("fallback", False)
+    kw.setdefault("shed_retries", 0)
+    return VerifydClient(addr, **kw)
+
+
+def test_forced_rungs_shed_classes_in_order_over_the_wire():
+    srv = VerifydServer(verify_fn=host_verify, max_batch=16, max_delay=0.005)
+    srv.start()
+    try:
+        h, p = srv.address
+        addr = f"{h}:{p}"
+        expectations = [
+            (LEVEL_SHED_RPC, {protocol.CLASS_RPC}),
+            (LEVEL_SHED_LIGHT, {protocol.CLASS_RPC, protocol.CLASS_LIGHT}),
+            (
+                LEVEL_SHED_BLOCKSYNC,
+                {
+                    protocol.CLASS_RPC,
+                    protocol.CLASS_LIGHT,
+                    protocol.CLASS_BLOCKSYNC,
+                },
+            ),
+        ]
+        for level, shed_classes in expectations:
+            srv.brownout.force(level)
+            for klass in (
+                protocol.CLASS_RPC,
+                protocol.CLASS_LIGHT,
+                protocol.CLASS_BLOCKSYNC,
+                protocol.CLASS_CONSENSUS,
+            ):
+                c = _client(addr)
+                if klass in shed_classes:
+                    with pytest.raises(VerifydRejectedError) as ei:
+                        c.verify(*make_lanes(2, seed=level), klass=klass)
+                    assert (
+                        ei.value.status
+                        == protocol.STATUS_RESOURCE_EXHAUSTED
+                    )
+                    assert "brownout" in str(ei.value)
+                else:
+                    got = c.verify(*make_lanes(2, seed=level), klass=klass)
+                    assert got == [True, True]
+                c.close()
+        srv.brownout.force(None)
+    finally:
+        srv.stop()
+
+
+def test_host_consensus_rung_survives_a_dead_device():
+    """Rung 5: the device path is GONE (verify_fn raises on every call)
+    yet consensus still answers correct verdicts via the host oracle,
+    with correct bad-lane attribution; sheddable classes shed."""
+
+    def dead_device(pks, msgs, sigs):
+        raise DeviceFault("chip fell off the bus", permanent=True)
+
+    srv = VerifydServer(verify_fn=dead_device, max_batch=16, max_delay=0.005)
+    srv.brownout.force(LEVEL_HOST_CONSENSUS)
+    srv.start()
+    try:
+        h, p = srv.address
+        c = _client(f"{h}:{p}", tenant="chain-a")
+        got = c.verify(
+            *make_lanes(4, seed=9, bad={2}), klass=protocol.CLASS_CONSENSUS
+        )
+        assert got == [True, True, False, True]
+        with pytest.raises(VerifydRejectedError):
+            c.verify(*make_lanes(2, seed=9), klass=protocol.CLASS_RPC)
+        c.close()
+        assert srv.host_direct_lanes == 4
+        stats = srv.tenant_stats()["chain-a"]
+        assert stats["host_direct"] == 4
+        assert stats["sheds"] == 1
+    finally:
+        srv.stop()
+
+
+def test_shrink_shares_rung_host_directs_consensus_past_share():
+    """Rung 4: budgets shrink to 1/4; consensus PAST the shrunken share
+    is never shed — it verifies host-direct instead."""
+    gate = threading.Event()
+    in_flight = threading.Event()
+
+    def gated(pks, msgs, sigs):
+        in_flight.set()
+        gate.wait(10)
+        return host_verify(pks, msgs, sigs)
+
+    # tenant_cap 8 -> shrunken share 2
+    srv = VerifydServer(
+        verify_fn=gated, max_batch=16, max_delay=0.005, tenant_cap=8
+    )
+    srv.brownout.force(LEVEL_SHRINK_SHARES)
+    srv.start()
+    try:
+        h, p = srv.address
+        results = {}
+        # 2 consensus lanes occupy the full shrunken share (gated)
+        t1 = threading.Thread(
+            target=lambda: results.__setitem__(
+                "first",
+                _client(f"{h}:{p}", tenant="chain-a").verify(
+                    *make_lanes(2, seed=3), klass=protocol.CLASS_CONSENSUS
+                ),
+            )
+        )
+        t1.start()
+        assert in_flight.wait(timeout=5)
+        deadline = time.monotonic() + 5
+        while (
+            srv.tenant_stats().get("chain-a", {}).get("depth", 0) < 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.002)
+        # consensus past the share: host-direct (blocked flush bypassed)
+        c2 = _client(f"{h}:{p}", tenant="chain-a")
+        got = c2.verify(
+            *make_lanes(3, seed=4, bad={1}), klass=protocol.CLASS_CONSENSUS
+        )
+        assert got == [True, False, True]
+        assert srv.host_direct_lanes == 3
+        c2.close()
+        gate.set()
+        t1.join(timeout=10)
+        assert results["first"] == [True, True]
+    finally:
+        gate.set()
+        srv.stop()
+
+
+# --- fault injection mid-dispatch --------------------------------------------
+
+
+def test_device_fault_mid_dispatch_zero_silent_drops():
+    """DeviceFault raised INSIDE a flush: the scheduler's fallback
+    verifies the same lanes on the host oracle — every concurrent
+    caller gets correct verdicts, nobody hangs, nobody is dropped."""
+    fail_once = [True]
+
+    def flaky(pks, msgs, sigs):
+        if fail_once[0]:
+            fail_once[0] = False
+            raise DeviceFault("injected mid-dispatch")
+        return host_verify(pks, msgs, sigs)
+
+    srv = VerifydServer(verify_fn=flaky, max_batch=64, max_delay=0.02)
+    srv.start()
+    try:
+        h, p = srv.address
+        results = {}
+        errors = []
+
+        def call(i):
+            try:
+                c = _client(f"{h}:{p}")
+                bad = {1} if i % 2 else ()
+                results[i] = (
+                    c.verify(*make_lanes(3, seed=i, bad=bad)),
+                    bad,
+                )
+                c.close()
+            except Exception as exc:
+                errors.append((i, exc))
+
+        threads = [
+            threading.Thread(target=call, args=(i,)) for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert not errors, errors
+        assert len(results) == 6  # zero drops
+        for i, (got, bad) in results.items():
+            want = [j not in bad for j in range(3)]
+            assert got == want, (i, got)
+        sched = srv.scheduler
+        assert sched.flush_errors >= 1
+        assert sched.fallback_flushes >= 1  # the fault was absorbed
+    finally:
+        srv.stop()
+
+
+def test_permanent_device_fault_every_flush_still_answers():
+    def dead(pks, msgs, sigs):
+        raise DeviceFault("permanently dead", permanent=True)
+
+    srv = VerifydServer(verify_fn=dead, max_batch=8, max_delay=0.005)
+    srv.start()
+    try:
+        h, p = srv.address
+        c = _client(f"{h}:{p}")
+        for i in range(3):
+            assert c.verify(*make_lanes(2, seed=20 + i, bad={0})) == [
+                False,
+                True,
+            ]
+        c.close()
+        assert srv.scheduler.fallback_flushes >= 3
+    finally:
+        srv.stop()
+
+
+# --- connection chaos --------------------------------------------------------
+
+
+def test_mid_frame_disconnect_leaves_server_serving():
+    """Clients that die mid-preface or mid-frame must not wedge the
+    event loop or leak their connection into other requests."""
+    srv = VerifydServer(verify_fn=host_verify, max_batch=8, max_delay=0.005)
+    srv.start()
+    try:
+        h, p = srv.address
+        # half a preface, then gone
+        s1 = socket.create_connection((h, p), timeout=2)
+        s1.sendall(PREFACE[: len(PREFACE) // 2])
+        s1.close()
+        # full preface then a torn frame header, then gone
+        s2 = socket.create_connection((h, p), timeout=2)
+        s2.sendall(PREFACE + b"\x00\x00\x40\x00")  # length says 64, sends 0
+        s2.close()
+        # garbage that is not HTTP/2 at all
+        s3 = socket.create_connection((h, p), timeout=2)
+        s3.sendall(b"GET / HTTP/1.1\r\n\r\n")
+        s3.close()
+        # the server still answers real clients promptly
+        c = _client(f"{h}:{p}")
+        assert c.verify(*make_lanes(3, seed=30, bad={1})) == [
+            True, False, True,
+        ]
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_slow_reader_does_not_stall_other_clients():
+    """A connection that completes the preface and then goes silent
+    (slowloris-style) must not block service to healthy clients."""
+    srv = VerifydServer(verify_fn=host_verify, max_batch=8, max_delay=0.005)
+    srv.start()
+    stalled = []
+    try:
+        h, p = srv.address
+        for _ in range(3):
+            s = socket.create_connection((h, p), timeout=2)
+            s.sendall(PREFACE)  # then... nothing, ever
+            stalled.append(s)
+        t0 = time.monotonic()
+        c = _client(f"{h}:{p}")
+        assert c.verify(*make_lanes(4, seed=31)) == [True] * 4
+        c.close()
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        for s in stalled:
+            s.close()
+        srv.stop()
+
+
+def test_kill_and_restart_under_continuous_load():
+    """The server dies and comes back on the same port while clients
+    keep submitting: every call either succeeds (possibly via retry) or
+    fails EXPLICITLY — no hangs, no silent losses."""
+    srv = VerifydServer(verify_fn=host_verify, max_batch=16, max_delay=0.005)
+    srv.start()
+    h, p = srv.address
+    outcomes = []
+    outcomes_mtx = threading.Lock()
+    stop_flag = threading.Event()
+
+    def loader(i):
+        c = VerifydClient(
+            f"{h}:{p}", retries=8, backoff=0.05, fallback=False
+        )
+        while not stop_flag.is_set():
+            try:
+                got = c.verify(*make_lanes(2, seed=40 + i))
+                outcome = "ok" if got == [True, True] else "bad"
+            except (VerifydUnavailableError, VerifydRejectedError):
+                outcome = "explicit_error"
+            with outcomes_mtx:
+                outcomes.append(outcome)
+            time.sleep(0.02)
+        c.close()
+
+    threads = [threading.Thread(target=loader, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    srv2 = None
+    try:
+        time.sleep(0.2)  # load established
+        srv.stop()  # chaos: the daemon dies under load
+        time.sleep(0.3)
+        srv2 = VerifydServer(
+            verify_fn=host_verify, host=h, port=p,
+            max_batch=16, max_delay=0.005,
+        )
+        srv2.start()  # ...and comes back on the same port
+        time.sleep(0.5)
+        stop_flag.set()
+        for t in threads:
+            t.join(timeout=15)
+        with outcomes_mtx:
+            snapshot = list(outcomes)
+        assert len(snapshot) == sum(
+            1 for o in snapshot if o in ("ok", "explicit_error")
+        )  # every call resolved explicitly, none vanished
+        assert snapshot.count("ok") >= 4  # service genuinely resumed
+        assert "bad" not in snapshot
+        # post-restart requests land on the new instance
+        assert srv2.requests_served >= 1
+    finally:
+        stop_flag.set()
+        for t in threads:
+            t.join(timeout=5)
+        if srv2 is not None:
+            srv2.stop()
+
+
+# --- tenant flood isolation (acceptance) -------------------------------------
+
+
+def test_tenant_flood_victim_p99_and_explicit_sheds():
+    """An aggressor tenant floods rpc traffic; the victim tenant's
+    consensus p99 stays within 3x its unloaded p99 (floored at 50ms so
+    a fast box doesn't make the bound vacuous), every aggressor request
+    resolves explicitly, and the aggressor's sheds stay in ITS bucket."""
+
+    def modeled(pks, msgs, sigs):
+        time.sleep(0.0003 * len(pks))  # modeled device: ~0.3ms/lane
+        return [True] * len(pks)
+
+    srv = VerifydServer(
+        verify_fn=modeled, max_batch=64, max_delay=0.002,
+        admission_cap=256, tenant_cap=48,
+    )
+    srv.start()
+    h, p = srv.address
+    addr = f"{h}:{p}"
+
+    # signing is pure-Python and GIL-heavy: build every lane up front
+    # so the timed region measures the SERVICE, not key arithmetic
+    victim_lanes = [make_lanes(4, seed=50 + i) for i in range(15)]
+    flood_lanes = make_lanes(16, seed=60)
+
+    def victim_round(c):
+        lat = []
+        for lanes in victim_lanes:
+            t0 = time.monotonic()
+            got = c.verify(*lanes, klass=protocol.CLASS_CONSENSUS)
+            lat.append(time.monotonic() - t0)
+            assert got == [True] * 4
+        lat.sort()
+        return lat[-1]  # p99 ~ max of 15 samples
+
+    try:
+        victim = VerifydClient(addr, tenant="victim", fallback=False)
+        victim_round(victim)  # warm-up: connections, schedulers, JIT-ish
+        unloaded_p99 = victim_round(victim)
+
+        flood_outcomes = []
+        flood_mtx = threading.Lock()
+        flood_stop = threading.Event()
+
+        def aggressor():
+            c = VerifydClient(
+                addr, tenant="flood", fallback=False, shed_retries=0
+            )
+            while not flood_stop.is_set():
+                try:
+                    c.verify(*flood_lanes, klass=protocol.CLASS_RPC)
+                    out = "ok"
+                except VerifydRejectedError as exc:
+                    assert (
+                        exc.status == protocol.STATUS_RESOURCE_EXHAUSTED
+                    )
+                    out = "shed"
+                    time.sleep(0.002)  # a real client would back off
+                with flood_mtx:
+                    flood_outcomes.append(out)
+            c.close()
+
+        floods = [threading.Thread(target=aggressor) for _ in range(6)]
+        for t in floods:
+            t.start()
+        time.sleep(0.1)  # flood established
+        try:
+            loaded_p99 = victim_round(victim)
+        finally:
+            flood_stop.set()
+            for t in floods:
+                t.join(timeout=10)
+        victim.close()
+
+        floor = 0.05
+        assert loaded_p99 <= 3 * max(unloaded_p99, floor), (
+            f"victim p99 {loaded_p99 * 1e3:.1f}ms vs unloaded "
+            f"{unloaded_p99 * 1e3:.1f}ms"
+        )
+        with flood_mtx:
+            sheds = flood_outcomes.count("shed")
+        # the flood genuinely overran its budget AND every overrun was
+        # an explicit wire status (the aggressor loop asserts the code)
+        assert sheds >= 1
+        stats = srv.tenant_stats()
+        assert stats["flood"]["sheds"] == sheds
+        assert stats.get("victim", {}).get("sheds", 0) == 0
+    finally:
+        srv.stop()
+
+
+# --- continuous batching proof (acceptance) ----------------------------------
+
+
+def test_trace_proves_admission_during_inflight_dispatch():
+    """The continuous-batching demonstration the issue asks for: a
+    ``scheduler_admit_inflight`` instant lands INSIDE the time window
+    of a ``scheduler_dispatch`` span — lanes were admitted while a
+    kernel was on the device."""
+    prior_mode = tracing.tracer.mode
+    tracing.configure("ring")
+    tracing.tracer.export(clear=True)  # drain other tests' events
+    gate = threading.Event()
+    in_flight = threading.Event()
+
+    def gated(pks, msgs, sigs):
+        in_flight.set()
+        gate.wait(10)
+        return host_verify(pks, msgs, sigs)
+
+    srv = VerifydServer(
+        verify_fn=gated, max_batch=4, max_delay=0.01,
+        continuous=True, pipeline_depth=2,
+    )
+    srv.start()
+    try:
+        h, p = srv.address
+        results = {}
+
+        def call(key, seed):
+            c = _client(f"{h}:{p}")
+            results[key] = c.verify(*make_lanes(4, seed=seed))
+            c.close()
+
+        t1 = threading.Thread(target=call, args=("a", 70))
+        t1.start()
+        assert in_flight.wait(timeout=5)  # dispatch 1 holds the device
+        t2 = threading.Thread(target=call, args=("b", 71))
+        t2.start()
+        # wait for the second group's admission to be traced
+        deadline = time.monotonic() + 5
+        while (
+            srv.scheduler.inflight_admissions < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        gate.set()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert results["a"] == [True] * 4
+        assert results["b"] == [True] * 4
+
+        events = tracing.tracer.export()["traceEvents"]
+        dispatches = [
+            e
+            for e in events
+            if e.get("ph") == "X" and e["name"] == "scheduler_dispatch"
+        ]
+        admits = [
+            e
+            for e in events
+            if e.get("ph") == "i"
+            and e["name"] == "scheduler_admit_inflight"
+        ]
+        assert dispatches and admits
+        contained = any(
+            d["ts"] <= a["ts"] <= d["ts"] + d["dur"]
+            for a in admits
+            for d in dispatches
+        )
+        assert contained, "no admission instant inside a dispatch span"
+        # the instant itself carries the proof: a kernel was in flight
+        assert all(a["args"]["inflight"] >= 1 for a in admits)
+    finally:
+        gate.set()
+        srv.stop()
+        tracing.configure(prior_mode)
