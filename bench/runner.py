@@ -162,6 +162,9 @@ def build_child_env(
         else:
             env[key] = val
     env.update(overrides)
+    # the sanitizer never rides into a bench child: instrumented locks
+    # and attribute hooks would poison every number the child reports
+    env.pop("TENDERMINT_TPU_SANITIZE", None)
     env[HEARTBEAT_FILE_ENV] = spool
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"

@@ -429,7 +429,7 @@ def run_verifyd(beat) -> dict:
         wall = time.perf_counter() - t0
         if errors or not lat:
             return {"verifyd": {"error": errors[:3] or ["no samples"]}}
-        sched = srv.scheduler
+        sched_stats = srv.scheduler.stats()
         lat.sort()
         total_lanes = len(lat) * n_lanes
         return {
@@ -443,11 +443,13 @@ def run_verifyd(beat) -> dict:
                 "wire_overhead_x": round((sum(lat) / len(lat)) / inproc_s, 2)
                 if inproc_s > 0
                 else None,
-                "flushes": sched.flushes,
+                "flushes": sched_stats["flushes"],
                 "mean_batch_occupancy": round(
-                    sched.entries_verified / max(1, sched.flushes), 1
+                    sched_stats["entries_verified"]
+                    / max(1, sched_stats["flushes"]),
+                    1,
                 ),
-                "cross_client_flushes": dict(srv.cross_client_flushes),
+                "cross_client_flushes": srv.stats()["cross_client_flushes"],
             }
         }
     finally:
@@ -547,7 +549,7 @@ def run_verifyd_tenants(beat) -> dict:
                 label: {"lanes": s["lanes"], "sheds": s["sheds"]}
                 for label, s in srv.tenant_stats().items()
             }
-            occupancy = srv.scheduler.dispatch_handoffs
+            occupancy = srv.scheduler.stats()["dispatch_handoffs"]
         finally:
             stop.set()
             srv.stop()

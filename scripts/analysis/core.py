@@ -141,6 +141,23 @@ def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
     return parents
 
 
+def decorator_names(
+    node: ast.AST,
+) -> List[Tuple[str, Optional[ast.Call]]]:
+    """``(terminal name, call node or None)`` per decorator on a
+    class/function: ``@instrument_attrs(exclude=...)`` yields
+    ``("instrument_attrs", <Call>)``, ``@sanitizer.instrument_attrs``
+    yields ``("instrument_attrs", None)``."""
+    out: List[Tuple[str, Optional[ast.Call]]] = []
+    for dec in getattr(node, "decorator_list", []):
+        call = dec if isinstance(dec, ast.Call) else None
+        target = call.func if call is not None else dec
+        name = dotted_name(target) or ""
+        if name:
+            out.append((name.split(".")[-1], call))
+    return out
+
+
 def dotted_name(node: ast.AST) -> Optional[str]:
     """``self._m._lock`` -> "self._m._lock"; None for non-name chains."""
     parts: List[str] = []

@@ -24,7 +24,15 @@ Rules:
   assignment (orphaned — it guards nothing); module-level globals are
   the one exception, accepted when the annotation names a lock created
   at module scope (the ops singleton-store pattern);
-- TPL004: malformed annotation text.
+- TPL004: malformed annotation text;
+- TPL005: coverage for the tpusan-instrumented classes — a ``self.X``
+  mutated from two or more thread-entry methods (anything but
+  ``__init__``) of a class decorated ``@instrument_attrs`` that carries
+  no ``guarded-by`` annotation at all. TPL001 only checks fields the
+  author remembered to annotate; TPL005 closes exactly that gap for the
+  classes that declared themselves concurrent by opting into the
+  sanitizer. Fields named by the decorator's ``exclude=(...)`` are
+  racy-by-design and skipped.
 
 Lock aliasing is understood one level deep: ``self._wake =
 threading.Condition(self._mtx)`` means holding ``_wake`` implies
@@ -47,7 +55,13 @@ import ast
 import re
 from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
 
-from scripts.analysis.core import Checker, Finding, Module, dotted_name
+from scripts.analysis.core import (
+    Checker,
+    Finding,
+    Module,
+    decorator_names,
+    dotted_name,
+)
 
 GUARD_RE = re.compile(r"guarded-by:\s*(?P<spec>[A-Za-z0-9_|]+(?:\([^)]*\))?)")
 NONE_RE = re.compile(r"^none\((?P<reason>[^)]*)\)$|^none$")
@@ -76,6 +90,10 @@ class _ClassInfo:
         self.locks: Set[str] = set()
         #: condition attr -> wrapped lock attr (Condition(self._mtx))
         self.aliases: Dict[str, str] = {}
+        #: decorated @instrument_attrs (tpusan attribute tracking)
+        self.instrumented = False
+        #: attrs named by instrument_attrs(exclude=...): racy by design
+        self.excluded: Set[str] = set()
 
 
 def _self_assign_targets(stmt: ast.stmt) -> List[str]:
@@ -105,6 +123,8 @@ class LockDisciplineChecker(Checker):
         "TPL002": "guarded-by names a lock the class never creates",
         "TPL003": "guarded-by annotation on a line with no self.X assignment",
         "TPL004": "malformed guarded-by annotation",
+        "TPL005": "unannotated shared-mutable attribute on an "
+        "instrumented class",
     }
 
     def check_module(self, module: Module) -> Iterator[Finding]:
@@ -184,6 +204,19 @@ class LockDisciplineChecker(Checker):
         self, module: Module, cls: ast.ClassDef, annotated_lines: Set[int]
     ) -> _ClassInfo:
         info = _ClassInfo(cls)
+        for name, call in decorator_names(cls):
+            if name != "instrument_attrs":
+                continue
+            info.instrumented = True
+            if call is not None:
+                for kw in call.keywords:
+                    if kw.arg != "exclude":
+                        continue
+                    for elt in ast.walk(kw.value):
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            info.excluded.add(elt.value)
         for node in ast.walk(cls):
             if not isinstance(node, (ast.Assign, ast.AnnAssign)):
                 continue
@@ -236,6 +269,7 @@ class LockDisciplineChecker(Checker):
                         f"the class never assigns self.{lock} from a "
                         "threading lock factory",
                     )
+        yield from self._verify_coverage(module, info)
         checked = {
             attr: locks
             for attr, (locks, _) in info.guarded.items()
@@ -255,6 +289,46 @@ class LockDisciplineChecker(Checker):
                     else frozenset()
                 )
                 yield from self._walk_fn(module, info, checked, item, held)
+
+    def _verify_coverage(
+        self, module: Module, info: _ClassInfo
+    ) -> Iterator[Finding]:
+        """TPL005: on an ``@instrument_attrs`` class, every attribute
+        mutated from >=2 thread-entry methods must carry SOME guarded-by
+        annotation (a real lock or an explicit ``none(reason)``) or be
+        listed in the decorator's ``exclude``. Mutation from two method
+        entries is the static proxy for "two threads can write this"."""
+        if not info.instrumented:
+            return
+        writers: Dict[str, Set[str]] = {}
+        first_line: Dict[str, int] = {}
+        for item in info.node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue
+            for node in ast.walk(item):
+                if not isinstance(node, ast.stmt):
+                    continue
+                for attr in _self_assign_targets(node):
+                    writers.setdefault(attr, set()).add(item.name)
+                    first_line.setdefault(attr, node.lineno)
+        for attr, methods in sorted(writers.items()):
+            if len(methods) < 2:
+                continue
+            if attr in info.guarded or attr in info.excluded:
+                continue
+            if attr in info.locks:
+                continue
+            yield Finding(
+                module.rel,
+                first_line[attr],
+                "TPL005",
+                f"{info.node.name}.{attr} is mutated from "
+                f"{len(methods)} thread-entry methods "
+                f"({', '.join(sorted(methods))}) but carries no "
+                "guarded-by annotation",
+            )
 
     def _expand(self, info: _ClassInfo, held: FrozenSet[str]) -> FrozenSet[str]:
         """Close the held set over Condition-wraps-lock aliases."""

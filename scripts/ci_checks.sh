@@ -232,6 +232,43 @@ print(
 )
 EOF
 
+echo "== tpusan: happens-before race detection =="
+# PR 12 stage: vector-clock happens-before detection over the
+# concurrent serving stack (scheduler hand-off, verifyd brownout/chaos,
+# evloop lifecycle). Any DATA RACE marker is a gate failure — the
+# report carries both access stacks and the lock sets held.
+rm -f /tmp/_tpusan_hb.log
+timeout -k 10 850 env TENDERMINT_TPU_SANITIZE=hb JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_scheduler.py tests/test_verifyd_chaos.py \
+    tests/test_evloop.py -q -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_tpusan_hb.log
+[ "${PIPESTATUS[0]}" -ne 0 ] && rc_total=1
+if grep -q "DATA RACE" /tmp/_tpusan_hb.log; then
+    echo "tpusan: data race detected (stacks above)" >&2
+    rc_total=1
+fi
+if grep -q "LOCK-ORDER CYCLE" /tmp/_tpusan_hb.log; then
+    echo "tpusan: lock-order cycle detected" >&2
+    rc_total=1
+fi
+
+echo "== tpusan: deterministic schedule exploration (10 seeds) =="
+# The continuous-batching scheduler under 10 seeded interleavings.
+# Same seed -> same schedule, byte-stable report: a failure here
+# reproduces exactly with TENDERMINT_TPU_SANITIZE=explore:<seed>.
+for seed in 0 1 2 3 4 5 6 7 8 9; do
+    timeout -k 10 180 env TENDERMINT_TPU_SANITIZE=explore:$seed \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/test_scheduler.py::TestContinuousBatching" -q \
+        -p no:cacheprovider -p no:xdist -p no:randomly \
+        > /tmp/_tpusan_explore.log 2>&1 || {
+        echo "tpusan explore: FAILED under seed $seed — replay with" \
+             "TENDERMINT_TPU_SANITIZE=explore:$seed" >&2
+        tail -20 /tmp/_tpusan_explore.log >&2
+        rc_total=1
+    }
+done
+
 echo "== tier-1 pytest =="
 set -o pipefail
 rm -f /tmp/_t1.log

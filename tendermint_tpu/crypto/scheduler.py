@@ -65,6 +65,7 @@ from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from tendermint_tpu.libs import tracing
+from tendermint_tpu.libs.sanitizer import instrument_attrs
 
 DEFAULT_MAX_BATCH = 256
 DEFAULT_MAX_DELAY = 0.002  # 2ms: well under a vote round-trip
@@ -121,6 +122,7 @@ class _Pending:
         return due
 
 
+@instrument_attrs
 class VerifyScheduler:
     """Batches concurrent single-signature verifies onto one verifier call.
 
@@ -375,6 +377,24 @@ class VerifyScheduler:
         """Outstanding dispatches (queued + inside verify_fn)."""
         with self._mtx:
             return self._inflight + len(self._dispatch_q)
+
+    def stats(self) -> dict:
+        """Locked snapshot of the observability counters. Monitors and
+        tests must read through this, not the raw attributes — every
+        counter is written under ``_mtx`` by the dispatch workers, so an
+        unlocked read races the hand-off path (tpusan flags it)."""
+        with self._mtx:
+            return {
+                "flushes": self.flushes,
+                "entries_verified": self.entries_verified,
+                "entries_coalesced": self.entries_coalesced,
+                "flush_errors": self.flush_errors,
+                "fallback_flushes": self.fallback_flushes,
+                "submit_rejections": self.submit_rejections,
+                "dispatch_handoffs": self.dispatch_handoffs,
+                "inflight_admissions": self.inflight_admissions,
+                "flush_reasons": dict(self.flush_reasons),
+            }
 
     def wait(self, entry: _Pending, timeout: float = 10.0) -> bool:
         """Block until the entry's batch flushed; False on timeout (fail

@@ -51,6 +51,7 @@ from typing import Callable, Dict, Optional
 
 from tendermint_tpu.libs import log
 from tendermint_tpu.libs.metrics import EvloopMetrics
+from tendermint_tpu.libs.sanitizer import instrument_attrs
 
 DEFAULT_WORKERS = 16
 DEFAULT_HIGH_WATER = 1 << 20  # pause reads past 1MB of unflushed response
@@ -58,6 +59,7 @@ DEFAULT_LOW_WATER = 1 << 18  # resume below 256KB
 RECV_SIZE = 65536
 
 
+@instrument_attrs
 class Transport:
     """Per-connection handle, safe to drive from worker threads. All
     socket I/O happens on the loop thread; this object only moves bytes
@@ -134,6 +136,7 @@ class Transport:
         return self.sock
 
 
+@instrument_attrs(exclude=("_conns",))  # connection_count: stats-grade
 class EvloopServer:
     """One selector loop + one bounded worker pool serving a listening
     socket owned by the caller (the caller binds/closes it; this class
@@ -159,57 +162,82 @@ class EvloopServer:
         self._logger = logger if logger is not None else log.NOP_LOGGER
         self.high_water = high_water
         self.low_water = min(low_water, high_water)
-        self._sel: Optional[selectors.BaseSelector] = None
+        # written by start()/stop() under _life_mtx; the loop thread's
+        # lock-free reads are ordered by Thread.start/join instead, so
+        # the lock checker can't model it as a plain guarded field
+        self._sel: Optional[selectors.BaseSelector] = None  # guarded-by: none(start-before-loop, join-before-teardown)
         self._conns: Dict[int, Transport] = {}  # guarded-by: none(loop thread only)
         self._dirty_mtx = threading.Lock()
         self._dirty: set = set()  # guarded-by: _dirty_mtx
         self._stopping = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self._pool: Optional[ThreadPoolExecutor] = None
-        self._wake_r: Optional[socket.socket] = None
-        self._wake_w: Optional[socket.socket] = None
+        # Lifecycle state is touched from whatever threads call
+        # start()/stop() AND from every worker issuing a wake/defer, so
+        # it rides one mutex; the loop thread itself only reads it via
+        # locals captured at _run entry.
+        self._life_mtx = threading.Lock()
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _life_mtx
+        self._pool: Optional[ThreadPoolExecutor] = None  # guarded-by: _life_mtx
+        self._wake_r: Optional[socket.socket] = None  # guarded-by: _life_mtx
+        self._wake_w: Optional[socket.socket] = None  # guarded-by: _life_mtx
 
     # --- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stopping.clear()
-        self._sel = selectors.DefaultSelector()
-        self._wake_r, self._wake_w = socket.socketpair()
-        self._wake_r.setblocking(False)
-        self._wake_w.setblocking(False)
-        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
-        lsock = self._listener_ref()
-        if lsock is not None:
-            lsock.setblocking(False)
-            self._sel.register(lsock, selectors.EVENT_READ, "listener")
-        self._pool = ThreadPoolExecutor(
-            max_workers=self._workers,
-            thread_name_prefix=f"{self.name}-worker",
-        )
-        self._thread = threading.Thread(
-            target=self._run, name=f"{self.name}-evloop", daemon=True
-        )
-        self._thread.start()
+        with self._life_mtx:
+            if self._thread is not None:
+                return
+            self._stopping.clear()
+            self._sel = selectors.DefaultSelector()
+            self._wake_r, self._wake_w = socket.socketpair()
+            self._wake_r.setblocking(False)
+            self._wake_w.setblocking(False)
+            self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+            lsock = self._listener_ref()
+            if lsock is not None:
+                lsock.setblocking(False)
+                self._sel.register(lsock, selectors.EVENT_READ, "listener")
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._workers,
+                thread_name_prefix=f"{self.name}-worker",
+            )
+            self._thread = threading.Thread(
+                target=self._run, name=f"{self.name}-evloop", daemon=True
+            )
+            self._thread.start()
 
     def stop(self) -> None:
-        thread, self._thread = self._thread, None
+        with self._life_mtx:
+            thread, self._thread = self._thread, None
         if thread is None:
             return
         self._stopping.set()
         self._wake()
+        # join OUTSIDE the mutex: workers must stay able to wake/defer
+        # while the loop drains its final pass
         thread.join(timeout=5)
-        if self._pool is not None:
-            self._pool.shutdown(wait=False)
-            self._pool = None
+        with self._life_mtx:
+            pool, self._pool = self._pool, None
+            # the loop thread is gone (or wedged past its join timeout);
+            # tear the wake pipe down here rather than in _run's finally
+            # so no thread but a stop() caller ever writes these fields
+            for s in (self._wake_r, self._wake_w):
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass  # shutdown path: wake socket already gone
+            self._wake_r = self._wake_w = None
+            self._sel = None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     def connection_count(self) -> int:
         # racy read of a loop-owned dict: stats-grade only
         return len(self._conns)
 
     def defer(self, fn: Callable[[], None]) -> None:
-        pool = self._pool
+        with self._life_mtx:
+            pool = self._pool
         if pool is None:
             return
         pool.submit(self._run_deferred, fn)
@@ -228,7 +256,8 @@ class EvloopServer:
     # --- loop-side machinery -------------------------------------------------
 
     def _wake(self) -> None:
-        w = self._wake_w
+        with self._life_mtx:
+            w = self._wake_w
         if w is None:
             return
         try:
@@ -424,7 +453,12 @@ class EvloopServer:
         self._set_interest(t, want)
 
     def _run(self) -> None:
-        sel = self._sel
+        # capture lifecycle state as locals: start() published these
+        # before spawning us, and stop() only tears them down after our
+        # join — going through self would race a concurrent stop()
+        with self._life_mtx:
+            sel = self._sel
+            wake_r = self._wake_r
         try:
             while not self._stopping.is_set():
                 try:
@@ -435,7 +469,7 @@ class EvloopServer:
                     data = key.data
                     if data == "wake":
                         try:
-                            while self._wake_r.recv(4096):
+                            while wake_r.recv(4096):
                                 pass
                         except (BlockingIOError, OSError):
                             pass  # wake pipe drained (or torn at stop)
@@ -471,11 +505,6 @@ class EvloopServer:
                 sel.close()
             except OSError:
                 pass  # shutdown path: selector may already be closed
-            for s in (self._wake_r, self._wake_w):
-                if s is not None:
-                    try:
-                        s.close()
-                    except OSError:
-                        pass  # shutdown path: wake socket already gone
-            self._wake_r = self._wake_w = None
-            self._sel = None
+            # the wake pipe outlives us: stop() closes it after joining
+            # this thread, so in-flight _wake() calls never hit a
+            # half-closed socket pair

@@ -1,43 +1,68 @@
-"""Runtime lock-order sanitizer: the dynamic half of tpulint.
+"""tpusan — runtime concurrency sanitizer for the serving stack.
 
-The static lock checker proves fields are touched under their lock; it
-cannot see the ORDER locks nest in across threads. A consistent global
-order is deadlock-free; an AB/BA inversion between two threads is a
-deadlock waiting for the right interleaving — the kind of bug that
-survives every test run until it takes down a validator. This module
-finds inversions without needing the deadlock to actually happen:
+Three modes, selected by ``TENDERMINT_TPU_SANITIZE`` (parsed by
+``install()``, normally from tests/conftest.py BEFORE jax or the package
+under test create any locks):
 
-When ``install()`` runs (or ``TENDERMINT_TPU_SANITIZE=1`` at conftest
-import), ``threading.Lock``/``threading.RLock`` are replaced by a
-wrapper that keeps a per-thread stack of held locks and records, on
-every acquisition, an edge from each held lock to the new one in a
-process-wide acquisition-order graph. Nodes are lock *creation sites*
-(``file:line`` of the constructor call), so the thousands of per-metric
-lock instances collapse into one node per class of lock. A cycle in
-that graph is a potential deadlock even if no run ever deadlocked.
+``=1`` — **lock-order mode** (the original sanitizer).
+    ``threading.Lock``/``threading.RLock`` are replaced by a wrapper
+    that keeps a per-thread stack of held locks and records, on every
+    acquisition, an edge from each held lock to the new one in a
+    process-wide acquisition-order graph. Nodes are lock *creation
+    sites* (``file:line`` of the constructor call), so the thousands of
+    per-metric lock instances collapse into one node per class of lock.
+    A cycle in that graph is a potential deadlock even if no run ever
+    deadlocked. Blocking IO under a lock is surfaced report-only.
 
-Also recorded, report-only: blocking IO (``time.sleep``,
-``socket.recv``/``accept``) entered while holding a sanitized lock.
-That is sometimes deliberate — the grpc client serializes whole calls
-under its connection mutex by design — so IO-under-lock findings are
-surfaced for review but do not fail CI; cycles do (ci_checks.sh greps
-for the ``LOCK-ORDER CYCLE`` marker).
+``=hb`` — **happens-before race detection** (implies lock-order mode).
+    Every thread carries a vector clock. Sync primitives thread the
+    clocks through: a lock release publishes the holder's clock on the
+    lock, an acquire joins it; ``Thread.start`` snapshots the parent
+    clock as the child's birth clock; ``Thread.join`` joins the dead
+    child's final clock. ``Event``, ``Condition`` and ``queue.Queue``
+    ride the same machinery because their internal locks are created
+    after install and are therefore sanitized (``queue.SimpleQueue`` is
+    aliased to ``queue.Queue`` so executor hand-offs get edges too).
+    Classes opted in with ``@instrument_attrs`` get per-attribute
+    access tracking: two accesses to the same attribute, at least one a
+    write, with no happens-before path between them is a **DATA RACE**,
+    reported with both access stacks and the locks each side held (the
+    sync evidence that failed to order them). ci_checks.sh greps for
+    the ``DATA RACE`` marker.
 
-Overhead is a dict update per acquisition — fine for tests, not for
-production; this is a test-harness tool, which is why it activates only
-via explicit env/install and never by import side effect.
+``=explore:<seed>`` — **deterministic schedule exploration** (implies hb).
+    Inside an ``explore_scope()`` (tests/conftest.py opens one per test
+    in this mode), participating threads — the scope owner plus every
+    thread it transitively starts — are serialized through a single
+    run token. At each sync point (lock acquire/release, tracked
+    attribute access) the token holder consults a PRNG seeded with
+    ``<seed>`` to pick which runnable participant goes next; a thread
+    about to truly block hands the token off first and re-queues after
+    waking. The schedule is a pure function of the seed, so a race
+    found in CI replays byte-identically from its seed on a laptop.
+
+Overhead is a dict update (plus, under hb, a short stack walk) per
+instrumented operation — fine for tests, not for production; this is a
+test-harness tool, which is why it activates only via explicit
+env/install and never by import side effect, and why bench/ strips the
+env var from child processes.
 """
 
 from __future__ import annotations
 
 import _thread
+import contextlib
 import os
+import queue as _queue_mod
+import random
+import re
 import socket
 import sys
 import threading
 import time
 import traceback
-from typing import Any, Dict, List, Optional, Set, Tuple
+import weakref
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
 ENV = "TENDERMINT_TPU_SANITIZE"
 
@@ -53,15 +78,81 @@ _orig_sleep = None
 _orig_recv = None
 _orig_accept = None
 
+# hb-mode patch originals
+_hb_on = False
+_orig_thread_start = None
+_orig_thread_join = None
+_orig_cond_wait = None
+_orig_cond_notify = None
+_orig_simple_queue = None
+
+_explore_seed: Optional[int] = None
+_explorer: Optional["_Explorer"] = None
+
 #: (from_site, to_site) -> example (thread name, to-site acquire stack)
 _edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
 #: (io kind, frozenset of held sites) -> example thread name
 _io_violations: Dict[Tuple[str, Tuple[str, ...]], str] = {}
 _known_sites: Set[str] = set()
 
+# --- happens-before state ----------------------------------------------------
+# Generation counter: reset() bumps it, lazily invalidating every
+# per-thread and per-lock clock without having to reach into other
+# threads' TLS.
+_hb_gen = 0
+_next_tid = 0
+#: (id(obj), attr) -> {"cls", "attr", "w": (tid, clock, acc)|None,
+#:                     "r": {tid: (clock, acc)}}
+#: where acc = (op, thread-disp, stack, held-lock-sites)
+_vars: Dict[Tuple[int, str], dict] = {}
+#: dedup key -> race record
+_races: Dict[Tuple, dict] = {}
+
+_RAW_LOCK_TYPE = type(_thread.allocate_lock())
+_DEFAULT_NAME_RE = re.compile(r"^(Thread-\d+|ThreadPoolExecutor-\d+_\d+)")
+
+_HERE = os.path.abspath(__file__)
+_rel_cache: Dict[str, str] = {}
+_skip_cache: Dict[str, bool] = {}
+
 
 def enabled_from_env() -> bool:
     return os.environ.get(ENV, "") not in ("", "0", "false", "no")
+
+
+def _parse_mode(value: str) -> Tuple[bool, Optional[int]]:
+    """``value`` -> (hb enabled, explore seed or None)."""
+    v = (value or "").strip().lower()
+    if v.startswith("explore"):
+        seed = 0
+        if ":" in v:
+            try:
+                seed = int(v.split(":", 1)[1])
+            except ValueError:
+                seed = 0
+        return True, seed
+    if v == "hb":
+        return True, None
+    return False, None
+
+
+def active_mode() -> str:
+    """One of ``off | lockorder | hb | explore``."""
+    if not _installed:
+        return "off"
+    if _explore_seed is not None:
+        return "explore"
+    if _hb_on:
+        return "hb"
+    return "lockorder"
+
+
+def hb_enabled() -> bool:
+    return _hb_on
+
+
+def explore_seed() -> Optional[int]:
+    return _explore_seed
 
 
 def _caller_site() -> str:
@@ -69,7 +160,6 @@ def _caller_site() -> str:
     threading internals (a Condition() allocates its RLock inside
     threading.py — the interesting site is Condition's caller)."""
     f = sys._getframe(2)
-    here = os.path.dirname(os.path.abspath(__file__))
     while f is not None:
         fn = f.f_code.co_filename
         if (
@@ -87,11 +177,313 @@ def _caller_site() -> str:
     return "<unknown>"
 
 
+def _relfile(fn: str) -> str:
+    r = _rel_cache.get(fn)
+    if r is None:
+        try:
+            rel = os.path.relpath(fn)
+        except ValueError:
+            rel = fn
+        r = fn if rel.startswith("..") else rel
+        _rel_cache[fn] = r
+    return r
+
+
+def _short_stack(limit: int = 6) -> Tuple[Tuple[str, int, str], ...]:
+    """Compact stack of the current access: (file, line, func) tuples,
+    innermost first, sanitizer frames skipped. Cheap enough to capture
+    on every tracked access; formatted only if a race is reported."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:
+        return ()
+    out: List[Tuple[str, int, str]] = []
+    while f is not None and len(out) < limit:
+        fn = f.f_code.co_filename
+        skip = _skip_cache.get(fn)
+        if skip is None:
+            skip = os.path.abspath(fn) == _HERE
+            _skip_cache[fn] = skip
+        if not skip:
+            out.append((_relfile(fn), f.f_lineno, f.f_code.co_name))
+        f = f.f_back
+    return tuple(out)
+
+
 def _held_stack() -> List["_SanitizedLock"]:
     stack = getattr(_tls, "held", None)
     if stack is None:
         stack = _tls.held = []
     return stack
+
+
+# --- vector clocks -----------------------------------------------------------
+
+
+class _HBThread:
+    __slots__ = ("tid", "vc", "gen", "disp")
+
+
+def _current_thread_obj() -> Optional[threading.Thread]:
+    """The current Thread object WITHOUT threading.current_thread():
+    during bootstrap a thread fires sync ops (``_started.set()``)
+    before it lands in ``threading._active``, and current_thread()
+    would then manufacture a _DummyThread whose Event recurses straight
+    back into the sanitizer. Returns None for truly foreign threads."""
+    ident = _thread.get_ident()
+    t = threading._active.get(ident)
+    if t is not None:
+        return t
+    try:
+        for t in list(threading._limbo.values()):
+            if t._ident == ident:
+                return t
+    except RuntimeError:
+        pass  # _limbo mutated under us: treat as a foreign thread
+    return None
+
+
+def _alloc_tid() -> int:
+    global _next_tid
+    with _state_mtx:
+        tid = _next_tid
+        _next_tid += 1
+    return tid
+
+
+def _hb_state() -> _HBThread:
+    """Per-thread hb state, lazily (re)created per generation. Thread
+    ids are dense ints preassigned by the parent at ``start()`` (so the
+    numbering is schedule-determined under the explorer and reports are
+    byte-stable for a given seed); threads the sanitizer never saw
+    start (the main thread, foreign pools) allocate on first sync."""
+    st = getattr(_tls, "hb", None)
+    if st is not None and st.gen == _hb_gen:
+        return st
+    cur = _current_thread_obj()
+    pre = getattr(cur, "_tpusan_tid", None) if cur is not None else None
+    if pre is not None and pre[0] == _hb_gen:
+        tid = pre[1]
+    else:
+        tid = _alloc_tid()
+    st = _HBThread()
+    st.tid = tid
+    st.gen = _hb_gen
+    st.vc = {tid: 1}
+    name = cur.name if cur is not None else ""
+    if not name or _DEFAULT_NAME_RE.match(name):
+        # auto-numbered names drift with the process-global thread
+        # counter; keep reports byte-stable across replays
+        st.disp = "T%d" % tid
+    else:
+        st.disp = "T%d(%s)" % (tid, name)
+    birth = getattr(cur, "_tpusan_birth", None) if cur is not None else None
+    if birth is not None and birth[0] == _hb_gen:
+        vc = st.vc
+        for t, c in birth[1].items():
+            if c > vc.get(t, 0):
+                vc[t] = c
+    _tls.hb = st
+    if cur is not None:
+        cur._tpusan_state = st
+    return st
+
+
+def _hb_lock_acquired(lock: Any) -> None:
+    # only the holder touches lock._hb_vc, so no extra locking needed
+    if getattr(lock, "_hb_gen", -1) != _hb_gen:
+        return
+    st = _hb_state()
+    vc = st.vc
+    for t, c in lock._hb_vc.items():
+        if c > vc.get(t, 0):
+            vc[t] = c
+
+
+def _hb_lock_released(lock: Any) -> None:
+    st = _hb_state()
+    lock._hb_vc = dict(st.vc)
+    lock._hb_gen = _hb_gen
+    st.vc[st.tid] = st.vc.get(st.tid, 0) + 1
+
+
+def _record_race_locked(v: dict, first: tuple, second: tuple) -> None:
+    key = (
+        v["cls"],
+        v["attr"],
+        first[0],
+        first[2][0] if first[2] else None,
+        second[0],
+        second[2][0] if second[2] else None,
+    )
+    if key in _races:
+        return
+    _races[key] = {
+        "cls": v["cls"],
+        "attr": v["attr"],
+        "first": first,
+        "second": second,
+    }
+
+
+def _note_var_access(obj: Any, name: str, is_write: bool) -> None:
+    if not _hb_on or getattr(_tls, "busy", False):
+        return
+    _tls.busy = True
+    try:
+        ex = _explorer
+        if ex is not None:
+            ex.maybe_switch()
+        st = _hb_state()
+        stack = _short_stack()
+        held = tuple(sorted({l._site for l in _held_stack()}))
+        acc = ("write" if is_write else "read", st.disp, stack, held)
+        tid = st.tid
+        vc = st.vc
+        clk = vc[tid]
+        key = (id(obj), name)
+        try:
+            ref = weakref.ref(obj)
+        except TypeError:
+            ref = None
+        with _state_mtx:
+            v = _vars.get(key)
+            if v is not None and v["ref"] is not None and v["ref"]() is not obj:
+                v = None  # id(obj) reuse: a dead object's record collided
+            if v is None:
+                v = _vars[key] = {
+                    "cls": type(obj).__name__,
+                    "attr": name,
+                    "ref": ref,
+                    "w": None,
+                    "r": {},
+                }
+            w = v["w"]
+            if w is not None and w[0] != tid and w[1] > vc.get(w[0], 0):
+                _record_race_locked(v, w[2], acc)
+            if is_write:
+                for rt, (rc, racc) in v["r"].items():
+                    if rt != tid and rc > vc.get(rt, 0):
+                        _record_race_locked(v, racc, acc)
+                v["w"] = (tid, clk, acc)
+                v["r"] = {}
+            else:
+                v["r"][tid] = (clk, acc)
+    finally:
+        _tls.busy = False
+
+
+# --- attribute instrumentation -----------------------------------------------
+
+_ATTR_REGISTRY: List[type] = []
+_WRAPPED: Dict[type, Tuple[Any, Any]] = {}
+
+_sync_types_cache: Optional[tuple] = None
+
+
+def _sync_types() -> tuple:
+    global _sync_types_cache
+    if _sync_types_cache is None:
+        _sync_types_cache = (
+            _SanitizedLock,
+            _RAW_LOCK_TYPE,
+            _thread.RLock,
+            threading.Condition,
+            threading.Event,
+            threading.Thread,
+            threading.Semaphore,
+            threading.Barrier,
+        )
+    return _sync_types_cache
+
+
+def instrument_attrs(cls=None, *, exclude: Tuple[str, ...] = ()):
+    """Class decorator opting a class into tpusan attribute tracking.
+
+    Free when the sanitizer is off: classes are only wrapped while hb
+    mode is active (env-installed runs wrap at decoration time; test
+    fixtures wrap retroactively via ``instrumented()``). ``exclude``
+    names attributes that are racy by design (documented stats-grade
+    reads) and must not be reported.
+    """
+
+    def deco(c: type) -> type:
+        c._tpusan_exclude = frozenset(exclude) | getattr(
+            c, "_tpusan_exclude", frozenset()
+        )
+        _ATTR_REGISTRY.append(c)
+        if _hb_on:
+            _wrap_class(c)
+        return c
+
+    if cls is None:
+        return deco
+    return deco(cls)
+
+
+def _wrap_class(cls: type) -> bool:
+    if cls in _WRAPPED:
+        return False
+    orig_ga = cls.__getattribute__
+    orig_sa = cls.__setattr__
+    exclude = getattr(cls, "_tpusan_exclude", frozenset())
+
+    def __getattribute__(self, name):
+        val = orig_ga(self, name)
+        if (
+            _hb_on
+            and name[:2] != "__"
+            and not name.startswith("_tpusan")
+            and name not in exclude
+        ):
+            try:
+                d = orig_ga(self, "__dict__")
+            except AttributeError:
+                return val
+            if name in d and not isinstance(val, _sync_types()):
+                _note_var_access(self, name, False)
+        return val
+
+    def __setattr__(self, name, value):
+        if (
+            _hb_on
+            and name[:2] != "__"
+            and not name.startswith("_tpusan")
+            and name not in exclude
+            and not isinstance(value, _sync_types())
+        ):
+            _note_var_access(self, name, True)
+        orig_sa(self, name, value)
+
+    cls.__getattribute__ = __getattribute__
+    cls.__setattr__ = __setattr__
+    _WRAPPED[cls] = (orig_ga, orig_sa)
+    return True
+
+
+def _unwrap_class(cls: type) -> None:
+    pair = _WRAPPED.pop(cls, None)
+    if pair is None:
+        return
+    cls.__getattribute__, cls.__setattr__ = pair
+
+
+@contextlib.contextmanager
+def instrumented(*classes: type) -> Iterator[None]:
+    """Wrap the given classes (default: every registered class) for the
+    duration — how tier-1 tests get attribute tracking without the env
+    var being set at import time. Classes already wrapped by an
+    env-mode install are left wrapped on exit."""
+    targets = list(classes) if classes else list(_ATTR_REGISTRY)
+    mine = [c for c in targets if _wrap_class(c)]
+    try:
+        yield
+    finally:
+        for c in mine:
+            _unwrap_class(c)
+
+
+# --- the lock wrapper --------------------------------------------------------
 
 
 class _SanitizedLock:
@@ -115,12 +507,15 @@ class _SanitizedLock:
         if self._reentrant and self._depth() > 0:
             stack.append(self)  # reentrant re-acquire: no new edges
             return
+        if _hb_on:
+            _hb_lock_acquired(self)
         held_sites = []
         for l in stack:
             if l._site != self._site and l._site not in held_sites:
                 held_sites.append(l._site)
         if held_sites:
-            who = threading.current_thread().name
+            cur = _current_thread_obj()
+            who = cur.name if cur is not None else "<foreign>"
             try:
                 frame = sys._getframe(3)
             except ValueError:
@@ -136,19 +531,44 @@ class _SanitizedLock:
         for i in range(len(stack) - 1, -1, -1):
             if stack[i] is self:
                 del stack[i]
-                return
+                break
+        else:
+            return
+        # publish the clock BEFORE the raw release so the next holder
+        # observes it (outermost release only, for RLocks)
+        if _hb_on and not (self._reentrant and self._depth() > 0):
+            _hb_lock_released(self)
 
     # --- lock protocol -------------------------------------------------------
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        ok = self._inner.acquire(blocking, timeout)
+        ex = _explorer
+        if ex is not None and ex.active and ex.current_part() is not None:
+            ex.maybe_switch()
+            if blocking:
+                ok = self._inner.acquire(False)
+                if not ok:
+                    # hand the run token off before truly blocking
+                    ex.block_begin()
+                    try:
+                        ok = self._inner.acquire(True, timeout)
+                    finally:
+                        ex.block_end()
+            else:
+                ok = self._inner.acquire(False)
+        else:
+            ok = self._inner.acquire(blocking, timeout)
         if ok:
             self._note_acquired()
         return ok
 
     def release(self) -> None:
-        self._inner.release()
         self._note_released()
+        self._inner.release()
+        ex = _explorer
+        if ex is not None and ex.active:
+            ex.note_wake()
+            ex.maybe_switch()
 
     def locked(self) -> bool:
         return self._inner.locked()
@@ -179,11 +599,17 @@ class _SanitizedLock:
             while self._depth() > 0:
                 self._note_released()
             if hasattr(self._inner, "_release_save"):
-                return (self._inner._release_save(), depth)
+                state = (self._inner._release_save(), depth)
+            else:
+                self._inner.release()
+                state = (None, depth)
+        else:
             self._inner.release()
-            return (None, depth)
-        self._inner.release()
-        return None
+            state = None
+        ex = _explorer
+        if ex is not None and ex.active:
+            ex.note_wake()
+        return state
 
     def _acquire_restore(self, state) -> None:
         if self._reentrant:
@@ -224,59 +650,444 @@ def _note_io(kind: str) -> None:
     if not stack:
         return
     sites = tuple(sorted({l._site for l in stack}))
-    who = threading.current_thread().name
+    cur = _current_thread_obj()
+    who = cur.name if cur is not None else "<foreign>"
     with _state_mtx:
         _io_violations.setdefault((kind, sites), who)
 
 
+@contextlib.contextmanager
+def _explorer_blocking() -> Iterator[None]:
+    """Release the explorer run token around a truly blocking call."""
+    ex = _explorer
+    if ex is not None and ex.active and ex.current_part() is not None:
+        ex.block_begin()
+        try:
+            yield
+        finally:
+            ex.block_end()
+    else:
+        yield
+
+
 def _sleep(seconds: float) -> None:
     _note_io("time.sleep")
-    _orig_sleep(seconds)
+    with _explorer_blocking():
+        _orig_sleep(seconds)
 
 
 def _recv(self, *args, **kwargs):
     _note_io("socket.recv")
-    return _orig_recv(self, *args, **kwargs)
+    with _explorer_blocking():
+        return _orig_recv(self, *args, **kwargs)
 
 
 def _accept(self, *args, **kwargs):
     _note_io("socket.accept")
-    return _orig_accept(self, *args, **kwargs)
+    with _explorer_blocking():
+        return _orig_accept(self, *args, **kwargs)
+
+
+# --- hb-mode thread / condition patches --------------------------------------
+
+
+def _thread_start(self):
+    if _hb_on:
+        st = _hb_state()
+        self._tpusan_birth = (_hb_gen, dict(st.vc))
+        self._tpusan_tid = (_hb_gen, _alloc_tid())
+        st.vc[st.tid] = st.vc.get(st.tid, 0) + 1
+    ex = _explorer
+    if ex is not None and ex.active and ex.current_part() is not None:
+        ex.adopt_child(self)
+        ex.note_wake()
+    return _orig_thread_start(self)
+
+
+def _thread_join(self, timeout=None):
+    with _explorer_blocking():
+        r = _orig_thread_join(self, timeout)
+    if _hb_on and not self.is_alive():
+        child = getattr(self, "_tpusan_state", None)
+        if child is not None and child.gen == _hb_gen:
+            st = _hb_state()
+            vc = st.vc
+            for t, c in child.vc.items():
+                if c > vc.get(t, 0):
+                    vc[t] = c
+    return r
+
+
+def _cond_wait(self, timeout=None):
+    with _explorer_blocking():
+        return _orig_cond_wait(self, timeout)
+
+
+def _cond_notify(self, n=1):
+    ex = _explorer
+    if ex is not None and ex.active:
+        ex.note_wake()
+    return _orig_cond_notify(self, n)
+
+
+def _enable_hb() -> None:
+    global _hb_on, _orig_thread_start, _orig_thread_join
+    global _orig_cond_wait, _orig_cond_notify, _orig_simple_queue
+    if _hb_on:
+        return
+    _orig_thread_start = threading.Thread.start
+    threading.Thread.start = _thread_start
+    _orig_thread_join = threading.Thread.join
+    threading.Thread.join = _thread_join
+    _orig_cond_wait = threading.Condition.wait
+    threading.Condition.wait = _cond_wait
+    _orig_cond_notify = threading.Condition.notify
+    threading.Condition.notify = _cond_notify
+    # SimpleQueue is C-implemented and invisible to the clocks; Queue is
+    # pure python over sanitized locks, so executor hand-offs get edges
+    _orig_simple_queue = _queue_mod.SimpleQueue
+    _queue_mod.SimpleQueue = _queue_mod.Queue
+    _hb_on = True
+    for c in list(_ATTR_REGISTRY):
+        _wrap_class(c)
+
+
+def _disable_hb() -> None:
+    global _hb_on
+    if not _hb_on:
+        return
+    threading.Thread.start = _orig_thread_start
+    threading.Thread.join = _orig_thread_join
+    threading.Condition.wait = _orig_cond_wait
+    threading.Condition.notify = _orig_cond_notify
+    _queue_mod.SimpleQueue = _orig_simple_queue
+    _hb_on = False
+    for c in list(_WRAPPED):
+        _unwrap_class(c)
+
+
+# --- deterministic schedule explorer -----------------------------------------
+
+
+class _Gate:
+    """One-shot token gate on a raw lock (never a sanitized primitive,
+    so the explorer cannot record or schedule itself)."""
+
+    __slots__ = ("_lk",)
+
+    def __init__(self):
+        self._lk = _thread.allocate_lock()
+        self._lk.acquire()
+
+    def wait(self, timeout: float) -> bool:
+        return self._lk.acquire(True, timeout)
+
+    def set(self) -> None:
+        try:
+            self._lk.release()
+        except RuntimeError:
+            pass  # already signalled: the gate is level, not a counter
+
+
+class _Part:
+    __slots__ = ("ex", "reg", "gate", "blocked", "ident")
+
+
+class _Explorer:
+    """Token-passing cooperative scheduler. Participants are the scope
+    owner and threads transitively started by participants; everything
+    else free-runs (its accesses are still race-checked by hb). Exactly
+    one non-blocked participant runs at a time; every sync point is a
+    PRNG-driven switch decision, so the interleaving is a deterministic
+    function of the seed."""
+
+    #: failsafe so a participant stuck behind an uninstrumented blocking
+    #: call degrades exploration instead of deadlocking the test run
+    STALL_TIMEOUT = 2.0
+    #: settle window after a block-state change: a thread woken from a
+    #: real block needs a moment of CPU to run block_end and re-park;
+    #: deciding before it settles would make the candidate set (and so
+    #: the rng stream) a function of OS wake latency, not the seed
+    GRACE = 0.002
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.mtx = _thread.allocate_lock()
+        self.active = True
+        self.parts: Dict[int, _Part] = {}  # ident -> part
+        self.all: List[_Part] = []  # includes preregistered children
+        self.holder: Optional[_Part] = None
+        self.next_reg = 0
+        self.switches = 0
+        self.stalls = 0
+        #: bumped on every wake-capable action (lock release, notify,
+        #: thread start/death); arms one settle window before the next
+        #: decision so a woken participant gets CPU to re-park
+        self.wake_epoch = 0
+        self.graced = -1
+
+    # --- registration --------------------------------------------------------
+
+    def _new_part_locked(self) -> _Part:
+        p = _Part()
+        p.ex = self
+        p.reg = self.next_reg
+        self.next_reg += 1
+        p.gate = _Gate()
+        p.blocked = 0
+        p.ident = None
+        self.all.append(p)
+        return p
+
+    def join_current(self) -> None:
+        me = _thread.get_ident()
+        with self.mtx:
+            p = self._new_part_locked()
+            p.ident = me
+            self.parts[me] = p
+            if self.holder is None:
+                self.holder = p
+
+    def adopt_child(self, thread: threading.Thread) -> None:
+        """Preregister a thread at start() time (parent-side, so the
+        candidate set is schedule-deterministic) and wrap its run() to
+        deregister on exit."""
+        with self.mtx:
+            p = self._new_part_locked()
+        thread._tpusan_part = p
+        orig_run = thread.run
+        ex = self
+
+        def _run(*a, **k):
+            try:
+                return orig_run(*a, **k)
+            finally:
+                ex.deregister_current()
+
+        thread.run = _run
+
+    def current_part(self) -> Optional[_Part]:
+        me = _thread.get_ident()
+        p = self.parts.get(me)
+        if p is not None:
+            return p
+        cur = _current_thread_obj()
+        pre = getattr(cur, "_tpusan_part", None) if cur is not None else None
+        if pre is not None and pre.ex is self:
+            with self.mtx:
+                cur = self.parts.get(me)
+                if cur is None:
+                    pre.ident = me
+                    self.parts[me] = pre
+                return self.parts[me]
+        return None
+
+    def deregister_current(self) -> None:
+        me = _thread.get_ident()
+        with self.mtx:
+            p = self.parts.pop(me, None)
+            if p is None:
+                cur = _current_thread_obj()
+                pre = (
+                    getattr(cur, "_tpusan_part", None)
+                    if cur is not None
+                    else None
+                )
+                if pre is not None and pre.ex is self:
+                    p = pre
+            if p is None:
+                return
+            if p in self.all:
+                self.all.remove(p)
+            self.wake_epoch += 1  # death unblocks joiners
+            if self.holder is p:
+                self._pass_token_locked(p)
+
+    # --- scheduling ----------------------------------------------------------
+
+    def maybe_switch(self) -> None:
+        if not self.active:
+            return
+        p = self.current_part()
+        if p is None:
+            return
+        wait_needed = False
+        for attempt in (0, 1):
+            grace_epoch = None
+            with self.mtx:
+                if not self.active or p.blocked:
+                    return
+                if self.holder is None:
+                    self.holder = p
+                if self.holder is not p:
+                    wait_needed = True
+                    break
+                if (
+                    attempt == 0
+                    and self.graced != self.wake_epoch
+                    and any(q.blocked for q in self.all)
+                ):
+                    grace_epoch = self.wake_epoch
+                else:
+                    cands = [q for q in self.all if not q.blocked]
+                    if len(cands) > 1:
+                        cands.sort(key=lambda q: q.reg)
+                        pick = self.rng.choice(cands)
+                        if pick is not p:
+                            self.holder = pick
+                            pick.gate.set()
+                            self.switches += 1
+                            wait_needed = True
+                    break
+            # settle window (token retained; only real-block wakers and
+            # free-runners can use it to reach their next sync point)
+            (_orig_sleep or time.sleep)(self.GRACE)
+            with self.mtx:
+                self.graced = grace_epoch
+        if wait_needed:
+            self._wait_token(p)
+
+    def note_wake(self) -> None:
+        """Record a wake-capable action (lock release, notify, thread
+        start/death). A participant blocked on the woken primitive needs
+        GIL time to run block_end and re-park; without the settle window
+        this re-arms, a holder in a tight loop would starve it and the
+        candidate set would depend on OS scheduling, not the seed."""
+        with self.mtx:
+            self.wake_epoch += 1
+
+    def block_begin(self) -> None:
+        p = self.current_part()
+        if p is None:
+            return
+        with self.mtx:
+            p.blocked += 1
+            if p.blocked == 1 and self.holder is p:
+                self._pass_token_locked(p)
+
+    def block_end(self) -> None:
+        p = self.current_part()
+        if p is None:
+            return
+        wait_needed = False
+        with self.mtx:
+            if p.blocked:
+                p.blocked -= 1
+            if not self.active:
+                return
+            if p.blocked == 0:
+                if self.holder is None:
+                    self.holder = p
+                elif self.holder is not p:
+                    wait_needed = True
+        if wait_needed:
+            self._wait_token(p)
+
+    def _pass_token_locked(self, exclude: _Part) -> None:
+        cands = [q for q in self.all if q is not exclude and not q.blocked]
+        if not cands:
+            self.holder = None
+            return
+        cands.sort(key=lambda q: q.reg)
+        pick = self.rng.choice(cands)
+        self.holder = pick
+        pick.gate.set()
+        self.switches += 1
+
+    def _wait_token(self, p: _Part) -> None:
+        while True:
+            got = p.gate.wait(self.STALL_TIMEOUT)
+            with self.mtx:
+                if not self.active:
+                    return
+                if self.holder is p:
+                    return
+                if not got:
+                    # failsafe: a participant wedged behind an
+                    # uninstrumented blocking call degrades exploration
+                    # instead of deadlocking the run
+                    self.stalls += 1
+                    self.holder = p
+                    return
+            # stale signal: the token was granted while this part was
+            # still free-running (pre-first-sync) and has since moved
+            # on; drain it and keep waiting
+
+    def shutdown(self) -> None:
+        with self.mtx:
+            self.active = False
+            self.holder = None
+            for p in self.all:
+                p.gate.set()
+            self.all = []
+            self.parts = {}
+
+
+@contextlib.contextmanager
+def explore_scope(seed: Optional[int] = None) -> Iterator[_Explorer]:
+    """Serialize threads started under this scope through the seeded
+    scheduler. Reentrant: a nested scope joins the active one."""
+    global _explorer
+    if _explorer is not None:
+        yield _explorer
+        return
+    if seed is None:
+        seed = _explore_seed if _explore_seed is not None else 0
+    ex = _Explorer(seed)
+    _explorer = ex
+    ex.join_current()
+    try:
+        yield ex
+    finally:
+        _explorer = None
+        ex.shutdown()
 
 
 # --- install / report ---------------------------------------------------------
 
 
-def install() -> None:
-    """Patch the lock factories and IO probes. Idempotent. Only locks
-    created AFTER install are sanitized — install before importing the
-    code under test (tests/conftest.py does)."""
+def install(mode: Optional[str] = None) -> None:
+    """Patch the lock factories and IO probes; with mode ``hb`` or
+    ``explore:<seed>`` also patch Thread.start/join, Condition.wait and
+    queue.SimpleQueue and wrap registered classes. Idempotent and
+    upgrade-only (install("hb") atop "1" adds hb; it never downgrades).
+    Only locks created AFTER install are sanitized — install before
+    importing the code under test (tests/conftest.py does)."""
     global _installed, _orig_lock, _orig_rlock
-    global _orig_sleep, _orig_recv, _orig_accept
-    if _installed:
-        return
-    _orig_lock = threading.Lock
-    _orig_rlock = threading.RLock
-    threading.Lock = _make_lock
-    threading.RLock = _make_rlock
-    _orig_sleep = time.sleep
-    time.sleep = _sleep
-    _orig_recv = socket.socket.recv
-    socket.socket.recv = _recv
-    _orig_accept = socket.socket.accept
-    socket.socket.accept = _accept
-    _installed = True
+    global _orig_sleep, _orig_recv, _orig_accept, _explore_seed
+    if mode is None:
+        mode = os.environ.get(ENV, "") or "1"
+    hb, seed = _parse_mode(mode)
+    if not _installed:
+        _orig_lock = threading.Lock
+        _orig_rlock = threading.RLock
+        threading.Lock = _make_lock
+        threading.RLock = _make_rlock
+        _orig_sleep = time.sleep
+        time.sleep = _sleep
+        _orig_recv = socket.socket.recv
+        socket.socket.recv = _recv
+        _orig_accept = socket.socket.accept
+        socket.socket.accept = _accept
+        _installed = True
+    if hb:
+        _enable_hb()
+    if seed is not None:
+        _explore_seed = seed
 
 
 def uninstall() -> None:
-    global _installed
+    global _installed, _explore_seed
     if not _installed:
         return
+    _disable_hb()
     threading.Lock = _orig_lock
     threading.RLock = _orig_rlock
     time.sleep = _orig_sleep
     socket.socket.recv = _orig_recv
     socket.socket.accept = _orig_accept
+    _explore_seed = None
     _installed = False
 
 
@@ -285,11 +1096,17 @@ def installed() -> bool:
 
 
 def reset() -> None:
-    """Drop recorded edges/violations (test isolation)."""
+    """Drop recorded edges/violations/races (test isolation). Bumping
+    the generation lazily invalidates every thread and lock clock."""
+    global _hb_gen, _next_tid
     with _state_mtx:
         _edges.clear()
         _io_violations.clear()
         _known_sites.clear()
+        _vars.clear()
+        _races.clear()
+        _hb_gen += 1
+        _next_tid = 0
 
 
 def _find_cycles(
@@ -330,40 +1147,80 @@ def _find_cycles(
     return cycles
 
 
+def _race_sort_key(r: dict):
+    return (r["cls"], r["attr"], r["first"][:3], r["second"][:3])
+
+
 def report() -> Dict[str, Any]:
     """Snapshot of findings: ``{"cycles": [...], "io_under_lock": [...],
-    "edges": N, "sites": N}``."""
+    "races": [...], "edges": N, "sites": N, "tracked_vars": N}``."""
     with _state_mtx:
         edges = dict(_edges)
         io = dict(_io_violations)
         nsites = len(_known_sites)
+        races = [dict(r) for r in _races.values()]
+        nvars = len(_vars)
     cycles = _find_cycles(edges)
+    races.sort(key=_race_sort_key)
     return {
         "cycles": cycles,
         "io_under_lock": [
             {"io": kind, "held": list(sites), "thread": who}
             for (kind, sites), who in sorted(io.items())
         ],
+        "races": races,
         "edges": len(edges),
         "sites": nsites,
+        "tracked_vars": nvars,
     }
 
 
+def _format_race(r: dict) -> str:
+    def top(acc):
+        return "%s:%d" % (acc[2][0][0], acc[2][0][1]) if acc[2] else "<unknown>"
+
+    def held(acc):
+        return ", ".join(acc[3]) if acc[3] else "none"
+
+    a, b = r["first"], r["second"]
+    lines = [
+        "DATA RACE: %s.%s: %s by %s at %s vs %s by %s at %s"
+        % (r["cls"], r["attr"], a[0], a[1], top(a), b[0], b[1], top(b)),
+        "  no happens-before path orders these accesses",
+        "  locks held: first [%s]; second [%s]" % (held(a), held(b)),
+    ]
+    for label, acc in (("first (%s)" % a[0], a), ("second (%s)" % b[0], b)):
+        lines.append("  %s stack:" % label)
+        for fn, ln, func in acc[2]:
+            lines.append("    %s:%d in %s" % (fn, ln, func))
+    return "\n".join(lines) + "\n"
+
+
+def race_report() -> str:
+    """Just the DATA RACE blocks, byte-stable for a given schedule —
+    what the same-seed replay test compares."""
+    return "".join(_format_race(r) for r in report()["races"])
+
+
 def print_report(stream=None) -> int:
-    """Human report; returns the number of cycles (CI fails on > 0).
-    The ``LOCK-ORDER CYCLE`` marker is the grep target for CI."""
+    """Human report; returns cycles + races (CI fails on > 0 in the
+    respective stage). ``LOCK-ORDER CYCLE`` and ``DATA RACE`` are the
+    grep targets for CI."""
     out = stream if stream is not None else sys.stderr
     snap = report()
     for cyc in snap["cycles"]:
         out.write("LOCK-ORDER CYCLE: " + " -> ".join(cyc) + "\n")
+    for r in snap["races"]:
+        out.write(_format_race(r))
     for v in snap["io_under_lock"]:
         out.write(
             "IO-UNDER-LOCK (report-only): %s while holding [%s] in %s\n"
             % (v["io"], ", ".join(v["held"]), v["thread"])
         )
-    if not snap["cycles"] and not snap["io_under_lock"]:
+    if not snap["cycles"] and not snap["races"] and not snap["io_under_lock"]:
         out.write(
-            "sanitizer: no lock-order cycles "
-            f"({snap['sites']} lock sites, {snap['edges']} order edges)\n"
+            "tpusan: no lock-order cycles, no data races "
+            f"({snap['sites']} lock sites, {snap['edges']} order edges, "
+            f"{snap['tracked_vars']} tracked vars)\n"
         )
-    return len(snap["cycles"])
+    return len(snap["cycles"]) + len(snap["races"])

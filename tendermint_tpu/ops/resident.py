@@ -45,6 +45,7 @@ from typing import Dict, Iterable, Optional, Sequence, Tuple
 import numpy as np
 
 from tendermint_tpu.libs import tracing
+from tendermint_tpu.libs.sanitizer import instrument_attrs
 
 _ENV = "TENDERMINT_TPU_RESIDENT"
 
@@ -65,6 +66,7 @@ def _platform(backend: Optional[str]) -> str:
         return "unknown"
 
 
+@instrument_attrs
 class ResidentTableStore:
     """Thread-safe device mirror of the host precompute cache."""
 

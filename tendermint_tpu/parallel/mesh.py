@@ -45,6 +45,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from tendermint_tpu.libs import tracing
+from tendermint_tpu.libs.sanitizer import instrument_attrs
 
 
 def _dp():
@@ -119,6 +120,7 @@ def _dev_id(device) -> int:
     return int(getattr(device, "id", 0))
 
 
+@instrument_attrs
 class MeshManager:
     """Process-wide mesh sizing + per-device health (module docstring)."""
 
@@ -314,25 +316,32 @@ class MeshManager:
     def on_success(self, plan: MeshPlan) -> None:
         """A sharded chunk materialized: record success on every device
         attempt (re-promoting any probing device)."""
+        # Settle the plan under _mtx: one plan serves every chunk of a
+        # batch, and concurrent on_success/on_failure calls otherwise
+        # race on plan.attempts (popped by on_failure) and
+        # plan.readmitted (mutated here).
         with self._mtx:
             metrics = self._metrics
-            health = {did: self._health.get(did) for did in plan.attempts}
-        newly_readmitted = []
-        for did, att in plan.attempts.items():
+            attempts = list(plan.attempts.items())
+            health = {did: self._health.get(did) for did, _ in attempts}
+            newly_readmitted = [
+                did
+                for did, att in attempts
+                if att.probe
+                and health.get(did) is not None
+                and did not in plan.readmitted
+            ]
+            plan.readmitted.update(newly_readmitted)
+            self.readmissions += len(newly_readmitted)
+        for did, att in attempts:
             h = health.get(did)
             if h is None:
                 continue
-            if att.probe and did not in plan.readmitted:
-                plan.readmitted.add(did)
-                newly_readmitted.append(did)
             h.record_success(att)
-        if newly_readmitted:
-            with self._mtx:
-                self.readmissions += len(newly_readmitted)
-            for did in newly_readmitted:
-                tracing.instant("mesh_device_readmitted", device=did)
-                if metrics is not None:
-                    metrics.mesh_readmissions.labels(device=str(did)).inc()
+        for did in newly_readmitted:
+            tracing.instant("mesh_device_readmitted", device=did)
+            if metrics is not None:
+                metrics.mesh_readmissions.labels(device=str(did)).inc()
 
     def on_failure(self, plan: MeshPlan, exc: BaseException) -> Optional[int]:
         """A sharded dispatch/collect failed. Returns the culprit device
@@ -345,26 +354,30 @@ class MeshManager:
             metrics = self._metrics
             if culprit is not None:
                 self.exclusions += 1
-            health = {did: self._health.get(did) for did in plan.attempts}
+            attempts = list(plan.attempts.items())
+            health = {did: self._health.get(did) for did, _ in attempts}
+            readmitted = set(plan.readmitted)
+            if culprit is not None:
+                # Drop the culprit's token under the lock: the same plan
+                # object may serve later (concurrent) chunks of the
+                # batch, and a stale on_success must not re-promote a
+                # chip just sent to COOLDOWN.
+                plan.attempts.pop(culprit, None)
         stall = _dp().DeviceStallError(
             "sharded dispatch failed"
             + (f" (device {culprit} excluded)" if culprit is not None else "")
         )
-        for did, att in plan.attempts.items():
+        for did, att in attempts:
             h = health.get(did)
             if h is None:
                 continue
             if did == culprit:
                 h.record_failure(exc, att)
-            elif att.probe and did not in plan.readmitted:
+            elif att.probe and did not in readmitted:
                 # The probe rode a dispatch that died: re-arm its cooldown
                 # rather than concluding anything about the device.
                 h.record_failure(stall, att)
         if culprit is not None:
-            # Drop the culprit's token: the same plan object may serve
-            # later chunks of the batch, and a stale on_success must not
-            # re-promote a chip just sent to COOLDOWN.
-            plan.attempts.pop(culprit, None)
             tracing.instant("mesh_device_excluded", device=culprit)
             if metrics is not None:
                 metrics.mesh_exclusions.labels(device=str(culprit)).inc()
@@ -375,10 +388,12 @@ class MeshManager:
         shared health machine denied every chunk): release un-dispatched
         probe reservations so excluded devices stay probe-able."""
         with self._mtx:
-            health = {did: self._health.get(did) for did in plan.attempts}
-        for did, att in plan.attempts.items():
+            attempts = list(plan.attempts.items())
+            health = {did: self._health.get(did) for did, _ in attempts}
+            readmitted = set(plan.readmitted)
+        for did, att in attempts:
             h = health.get(did)
-            if h is not None and did not in plan.readmitted:
+            if h is not None and did not in readmitted:
                 h.release_probe(att)
 
     # --- inspection -----------------------------------------------------------

@@ -66,6 +66,7 @@ from tendermint_tpu.crypto.scheduler import (
 )
 from tendermint_tpu.libs import tracing
 from tendermint_tpu.libs.grpc import GrpcServer, current_conn_tag
+from tendermint_tpu.libs.sanitizer import instrument_attrs
 from tendermint_tpu.libs.metrics import VerifydMetrics
 from tendermint_tpu.verifyd import protocol
 from tendermint_tpu.verifyd.protocol import (
@@ -146,6 +147,7 @@ def _device_cooling() -> bool:
         return False
 
 
+@instrument_attrs
 class BrownoutController:
     """Walks the degradation ladder on sustained pressure.
 
@@ -199,6 +201,17 @@ class BrownoutController:
             if cooling:
                 lvl = max(lvl, LEVEL_HOST_CONSENSUS)
         return lvl
+
+    def snapshot(self) -> dict:
+        """Locked view of the ladder state for monitors and tests —
+        reading ``transitions`` raw races every in-flight ``observe``."""
+        with self._mtx:
+            return {
+                "level": self._level,
+                "forced": self._forced,
+                "effective": self._effective_locked(),
+                "transitions": dict(self.transitions),
+            }
 
     def observe(
         self, pressure: bool, now: Optional[float] = None
@@ -282,6 +295,7 @@ def _host_sr25519_verify(pks, msgs, sigs) -> List[bool]:
     return [sr_verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
 
 
+@instrument_attrs
 class AdmissionController:
     """Sheds sheddable-class load when the queue is past budget.
 
@@ -334,6 +348,7 @@ class AdmissionController:
         return None
 
 
+@instrument_attrs
 class VerifydServer:
     """The verification daemon. ``verify_fn`` defaults to the tiered
     host/device ed25519 dispatch; tests inject a host oracle."""
@@ -473,6 +488,22 @@ class VerifydServer:
                 ts = _TenantState(sanitize_tenant_label(name))
             self._tenants[name] = ts
             return ts
+
+    def stats(self) -> Dict[str, object]:
+        """Locked snapshot of the wire counters. Handler threads write
+        these under ``_stats_mtx`` while requests are in flight, so live
+        monitors (tests polling mid-run, bench sections) must read here
+        — a raw attribute read races the serving path even after a
+        client got its response, because the TCP round-trip is not a
+        synchronization edge the counters ride on."""
+        with self._stats_mtx:
+            return {
+                "requests_served": self.requests_served,
+                "admission_rejections": self.admission_rejections,
+                "deadline_expired": self.deadline_expired,
+                "host_direct_lanes": self.host_direct_lanes,
+                "cross_client_flushes": dict(self.cross_client_flushes),
+            }
 
     def tenant_stats(self) -> Dict[str, Dict[str, int]]:
         """Per-label accounting snapshot (bench/chaos introspection)."""

@@ -16,9 +16,10 @@ os.environ["XLA_FLAGS"] = (
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-# The lock-order sanitizer must patch threading BEFORE jax (and the
-# package under test) create any locks, so this sits above the jax
-# import. Activated only by TENDERMINT_TPU_SANITIZE=1 (ci_checks.sh).
+# tpusan must patch threading BEFORE jax (and the package under test)
+# create any locks, so this sits above the jax import. Activated only
+# by TENDERMINT_TPU_SANITIZE=1|hb|explore:<seed> (ci_checks.sh);
+# install() parses the mode from the env var itself.
 from tendermint_tpu.libs import sanitizer as _sanitizer
 
 if _sanitizer.enabled_from_env():
@@ -33,14 +34,28 @@ import pytest
 
 def pytest_terminal_summary(terminalreporter):
     """With the sanitizer on, print its findings at the end of the run.
-    ci_checks.sh greps the output for the LOCK-ORDER CYCLE marker."""
+    ci_checks.sh greps the output for the LOCK-ORDER CYCLE and
+    DATA RACE markers."""
     if _sanitizer.installed():
         class _Writer:
             def write(self, text):
                 terminalreporter.write(text)
 
-        terminalreporter.section("lock-order sanitizer")
+        terminalreporter.section("tpusan (concurrency sanitizer)")
         _sanitizer.print_report(_Writer())
+
+
+@pytest.fixture(autouse=True)
+def _tpusan_explore():
+    """Under TENDERMINT_TPU_SANITIZE=explore:<seed>, serialize each
+    test's threads through the seeded cooperative scheduler. Threads
+    started outside the test (jax pools, leaked daemons) free-run; the
+    per-test scope keeps the schedule a pure function of the seed."""
+    if _sanitizer.active_mode() == "explore":
+        with _sanitizer.explore_scope():
+            yield
+    else:
+        yield
 
 
 @pytest.fixture(autouse=True)

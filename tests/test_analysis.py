@@ -201,6 +201,101 @@ class TestLockDiscipline:
         assert run_on(LockDisciplineChecker(), {"m.py": src}) == []
 
 
+# --- instrumented-class coverage (TPL005) ------------------------------------
+
+
+TPL005_DIRTY = """
+    import threading
+    from tendermint_tpu.libs.sanitizer import instrument_attrs
+
+    @instrument_attrs
+    class Pool:
+        def __init__(self):
+            self._mtx = threading.Lock()
+            self.depth = 0
+
+        def grow(self):
+            with self._mtx:
+                self.depth += 1
+
+        def shrink(self):
+            with self._mtx:
+                self.depth -= 1
+"""
+
+TPL005_CLEAN = """
+    import threading
+    from tendermint_tpu.libs.sanitizer import instrument_attrs
+
+    @instrument_attrs
+    class Pool:
+        def __init__(self):
+            self._mtx = threading.Lock()
+            self.depth = 0  # guarded-by: _mtx
+
+        def grow(self):
+            with self._mtx:
+                self.depth += 1
+
+        def shrink(self):
+            with self._mtx:
+                self.depth -= 1
+"""
+
+
+class TestInstrumentedCoverage:
+    def test_flags_unannotated_multi_writer_attr(self):
+        found = run_on(LockDisciplineChecker(), {"m.py": TPL005_DIRTY})
+        assert codes(found) == ["TPL005"]
+        assert "Pool.depth" in found[0].message
+        assert "grow" in found[0].message and "shrink" in found[0].message
+
+    def test_annotated_twin_passes(self):
+        assert run_on(LockDisciplineChecker(), {"m.py": TPL005_CLEAN}) == []
+
+    def test_single_writer_method_is_not_shared(self):
+        src = TPL005_DIRTY.replace(
+            "        def shrink(self):\n"
+            "            with self._mtx:\n"
+            "                self.depth -= 1\n",
+            "",
+        )
+        assert "shrink" not in src  # the replace actually fired
+        assert run_on(LockDisciplineChecker(), {"m.py": src}) == []
+
+    def test_decorator_exclude_suppresses(self):
+        src = TPL005_DIRTY.replace(
+            "@instrument_attrs",
+            '@instrument_attrs(exclude=("depth",))',
+        )
+        assert run_on(LockDisciplineChecker(), {"m.py": src}) == []
+
+    def test_none_reason_annotation_suppresses(self):
+        src = TPL005_DIRTY.replace(
+            "self.depth = 0",
+            "self.depth = 0  # guarded-by: none(stats-grade, torn reads ok)",
+        )
+        assert run_on(LockDisciplineChecker(), {"m.py": src}) == []
+
+    def test_uninstrumented_class_is_out_of_scope(self):
+        src = "\n".join(
+            ln
+            for ln in TPL005_DIRTY.splitlines()
+            if "@instrument_attrs" not in ln
+        )
+        assert run_on(LockDisciplineChecker(), {"m.py": src}) == []
+
+    def test_dotted_decorator_form_is_recognized(self):
+        src = TPL005_DIRTY.replace(
+            "@instrument_attrs", "@sanitizer.instrument_attrs"
+        ).replace(
+            "from tendermint_tpu.libs.sanitizer import instrument_attrs",
+            "from tendermint_tpu.libs import sanitizer",
+        )
+        found = run_on(LockDisciplineChecker(), {"m.py": src})
+        assert codes(found) == ["TPL005"]
+
+
 # --- JAX purity --------------------------------------------------------------
 
 

@@ -98,6 +98,23 @@ def test_retry_ladder_halves_knobs_and_lands_on_cpu(monkeypatch):
     assert runner.ladder_env(sec, 3)["BENCH_BATCH"] == "256"  # floor
 
 
+def test_child_env_strips_sanitizer(monkeypatch):
+    """tpusan must never ride into a bench child: instrumented locks
+    would poison every number it reports. The runner strips the env var
+    no matter what mode the parent runs under."""
+    sec = sections.get("host_ref")
+    for mode in ("1", "hb", "explore:42"):
+        monkeypatch.setenv("TENDERMINT_TPU_SANITIZE", mode)
+        env = runner.build_child_env(sec, {}, "/tmp/spool", False)
+        assert "TENDERMINT_TPU_SANITIZE" not in env
+    # and an explicit override cannot smuggle it back pre-strip
+    monkeypatch.delenv("TENDERMINT_TPU_SANITIZE", raising=False)
+    env = runner.build_child_env(
+        sec, {"TENDERMINT_TPU_SANITIZE": "hb"}, "/tmp/spool", False
+    )
+    assert "TENDERMINT_TPU_SANITIZE" not in env
+
+
 # --- heartbeat / watchdog units ---------------------------------------------
 
 

@@ -173,10 +173,17 @@ class TestContinuousBatching:
             # and dispatched without waiting for dispatch 1 to return
             second = [s.submit(*_signed(4 + i)) for i in range(4)]
             deadline = time.monotonic() + 5
-            while s.dispatch_handoffs < 2 and time.monotonic() < deadline:
+            # poll through the locked stats() snapshot: the dispatcher is
+            # still writing these counters, so a raw attribute read here
+            # is a data race (tpusan hb mode flags it)
+            while (
+                s.stats()["dispatch_handoffs"] < 2
+                and time.monotonic() < deadline
+            ):
                 time.sleep(0.005)
-            assert s.dispatch_handoffs >= 2
-            assert s.inflight_admissions >= 1
+            stats = s.stats()
+            assert stats["dispatch_handoffs"] >= 2
+            assert stats["inflight_admissions"] >= 1
             # the second batch resolves while the first is STILL blocked
             assert s.wait_many(second, timeout=5) == [True] * 4
             assert not first[0].done.is_set()

@@ -1,0 +1,332 @@
+"""tpusan happens-before + schedule-explorer tests.
+
+The hb detector is exact on the schedule it observes: a race is
+reported iff two conflicting accesses are not ordered by any chain of
+sync edges (lock release->acquire, Thread.start/join, Event/Condition,
+queue hand-off). The fixture pair below is the calibration standard —
+the racy twin MUST be flagged with both stacks, the guarded twin MUST
+stay silent — and the explorer makes the verdict a pure function of
+the seed, which the byte-identical replay test pins.
+
+These tests install hb mode themselves, so they run (and must pass)
+in a plain tier-1 run with TENDERMINT_TPU_SANITIZE unset.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.libs import sanitizer as san
+
+
+# --- fixture twins -----------------------------------------------------------
+
+
+@san.instrument_attrs
+class RacyCounter:
+    """The seeded race: ``n`` is mutated with no lock and polled from
+    another thread. tpusan must flag the read/write pair."""
+
+    def __init__(self):
+        self.n = 0
+
+    def bump_many(self, k):
+        for _ in range(k):
+            self.n += 1
+
+
+@san.instrument_attrs
+class GuardedCounter:
+    """The clean twin: same shape, every access under ``_mtx``."""
+
+    def __init__(self):
+        self._mtx = threading.Lock()
+        self.n = 0  # guarded-by: _mtx
+
+    def bump_many(self, k):
+        for _ in range(k):
+            with self._mtx:
+                self.n += 1
+
+    def value(self):
+        with self._mtx:
+            return self.n
+
+
+@pytest.fixture()
+def hb():
+    """Enable hb mode for one test (or reuse a global env install),
+    always restoring the pre-test state."""
+    was_installed = san.installed()
+    was_hb = san.hb_enabled()
+    san.install(mode="hb")
+    san.reset()
+    try:
+        yield san
+    finally:
+        san.reset()
+        if not was_installed:
+            san.uninstall()
+        elif not was_hb:
+            san._disable_hb()
+
+
+# --- the detector ------------------------------------------------------------
+
+
+def test_hb_detects_seeded_fixture_race(hb):
+    box = RacyCounter()
+    t = threading.Thread(target=box.bump_many, args=(200,), daemon=True)
+    t.start()
+    # unsynchronized poll: start() orders parent->child only, so these
+    # reads have NO happens-before path from the child's writes
+    deadline = time.monotonic() + 5
+    while box.n < 200 and time.monotonic() < deadline:
+        time.sleep(0.001)
+    t.join(timeout=5)
+
+    races = hb.report()["races"]
+    assert any(
+        r["cls"] == "RacyCounter" and r["attr"] == "n" for r in races
+    ), races
+    text = hb.race_report()
+    assert "DATA RACE: RacyCounter.n" in text
+    # both access stacks are in the report, pointing at real code
+    assert "first (" in text and "second (" in text
+    assert "bump_many" in text  # the writer frame
+    assert "test_hb_detects_seeded_fixture_race" in text  # the reader frame
+
+
+def test_guarded_twin_is_silent(hb):
+    box = GuardedCounter()
+    t = threading.Thread(target=box.bump_many, args=(200,), daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while box.value() < 200 and time.monotonic() < deadline:
+        time.sleep(0.001)
+    t.join(timeout=5)
+    assert box.value() == 200
+    assert hb.race_report() == ""
+
+
+def test_join_edge_orders_post_join_reads(hb):
+    """A raw read AFTER join is ordered (the child's final clock merges
+    into the joiner) — tpusan must not cry wolf on the join idiom."""
+    box = RacyCounter()
+    t = threading.Thread(target=box.bump_many, args=(50,), daemon=True)
+    t.start()
+    t.join(timeout=5)
+    assert box.n == 50
+    assert hb.race_report() == ""
+
+
+def test_lock_edge_orders_handoff(hb):
+    """Release->acquire on the same lock is an edge: a value written
+    under the lock then read under the lock is never a race."""
+    box = GuardedCounter()
+    done = threading.Event()
+
+    def writer():
+        box.bump_many(10)
+        done.set()
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    assert done.wait(timeout=5)
+    assert box.value() == 10
+    t.join(timeout=5)
+    assert hb.race_report() == ""
+
+
+# --- the explorer ------------------------------------------------------------
+
+
+def _explore_racy_round(seed):
+    san.reset()
+    with san.explore_scope(seed):
+        box = RacyCounter()
+        ts = [
+            threading.Thread(target=box.bump_many, args=(25,), daemon=True)
+            for _ in range(2)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+    return san.race_report()
+
+
+def test_same_seed_replays_byte_identical(hb):
+    """The replay contract: one seed, one schedule, one report. A race
+    found in CI under explore:<seed> reproduces exactly from the seed."""
+    for seed in (0, 42, 123):
+        first = _explore_racy_round(seed)
+        assert "DATA RACE: RacyCounter.n" in first
+        for _ in range(2):
+            assert _explore_racy_round(seed) == first
+
+
+def test_explorer_serializes_guarded_twin_clean(hb):
+    for seed in (0, 7):
+        san.reset()
+        with san.explore_scope(seed):
+            box = GuardedCounter()
+            ts = [
+                threading.Thread(
+                    target=box.bump_many, args=(25,), daemon=True
+                )
+                for _ in range(2)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=10)
+        assert box.value() == 50
+        assert san.race_report() == ""
+
+
+# --- regression pins for the production race fixes ---------------------------
+
+
+def _mini_scheduler():
+    from tendermint_tpu.crypto.scheduler import VerifyScheduler
+
+    return VerifyScheduler(
+        lambda pks, msgs, sigs: [True] * len(pks),
+        max_batch=4,
+        max_delay=0.002,
+        continuous=True,
+        pipeline_depth=2,
+    )
+
+
+def test_raw_counter_poll_is_the_bug_hb_catches(hb):
+    """The pre-fix pattern in tests/bench — polling a raw scheduler
+    counter while the dispatcher runs — is a real race and hb says so.
+    (The suites now poll via stats(); this pins WHY.)"""
+    s = _mini_scheduler()
+    s.start()
+    try:
+        handles = [s.submit(b"p%d" % i, b"m", b"s") for i in range(8)]
+        deadline = time.monotonic() + 5
+        while s.dispatch_handoffs < 1 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert s.wait_many(handles, timeout=5) == [True] * 8
+    finally:
+        s.stop()
+    races = hb.report()["races"]
+    assert any(
+        r["cls"] == "VerifyScheduler" and r["attr"] == "dispatch_handoffs"
+        for r in races
+    ), races
+
+
+def test_scheduler_stats_poll_is_race_free(hb):
+    """The fix: the same poll through the locked stats() snapshot has a
+    release->acquire edge from every counter write. Failed before
+    stats() existed."""
+    s = _mini_scheduler()
+    s.start()
+    try:
+        handles = [s.submit(b"p%d" % i, b"m", b"s") for i in range(8)]
+        deadline = time.monotonic() + 5
+        while (
+            s.stats()["dispatch_handoffs"] < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.001)
+        assert s.wait_many(handles, timeout=5) == [True] * 8
+    finally:
+        s.stop()
+    assert hb.race_report() == "", hb.race_report()
+
+
+def test_brownout_snapshot_is_race_free(hb):
+    """The verifyd observe path: a load thread drives the ladder while
+    the main thread reads through snapshot(). Pre-fix, reading .level
+    and .transitions raw was unordered against observe()'s writes."""
+    from tendermint_tpu.verifyd.server import BrownoutController
+
+    b = BrownoutController(escalate_after=0.01, cooldown_fn=None)
+    stop = threading.Event()
+
+    def load():
+        t = 0.0
+        while not stop.is_set():
+            t += 0.02
+            b.observe(True, now=t)
+
+    th = threading.Thread(target=load, daemon=True)
+    th.start()
+    deadline = time.monotonic() + 5
+    snap = b.snapshot()
+    while snap["level"] == 0 and time.monotonic() < deadline:
+        time.sleep(0.001)
+        snap = b.snapshot()
+    stop.set()
+    th.join(timeout=5)
+    assert snap["level"] >= 1
+    assert sum(snap["transitions"].values()) >= 1
+    races = hb.report()["races"]
+    assert not [r for r in races if r["cls"] == "BrownoutController"], races
+
+
+def test_mesh_settlement_is_race_free(hb):
+    """Concurrent plan settlement: on_success/on_failure from worker
+    threads while another thread reads snapshot(). Pre-fix the
+    settlement loop iterated plan.attempts outside _mtx.
+
+    Uses a fresh MeshManager: hb only sees locks created after
+    install, and the module singleton's _mtx predates this test's
+    install (the env-mode CI stage installs before any import, so
+    there the singleton IS covered)."""
+    from tendermint_tpu.parallel import mesh
+
+    mgr = mesh.MeshManager()
+    mgr.configure(2)
+    stop = threading.Event()
+
+    def settle():
+        while not stop.is_set():
+            plan = mgr.plan()
+            if plan is None:
+                return
+            mgr.on_success(plan)
+
+    def observe():
+        while not stop.is_set():
+            mgr.snapshot()
+
+    ts = [
+        threading.Thread(target=settle, daemon=True),
+        threading.Thread(target=settle, daemon=True),
+        threading.Thread(target=observe, daemon=True),
+    ]
+    for t in ts:
+        t.start()
+    time.sleep(0.05)
+    stop.set()
+    for t in ts:
+        t.join(timeout=5)
+    races = hb.report()["races"]
+    assert not [r for r in races if r["cls"] == "MeshManager"], races
+
+
+def test_continuous_batching_clean_across_25_schedules(hb):
+    """The acceptance bar: the full submit -> coalesce -> dispatch ->
+    resolve cycle of the continuous scheduler is race-free under 25
+    distinct explored interleavings."""
+    for seed in range(25):
+        san.reset()
+        with san.explore_scope(seed):
+            s = _mini_scheduler()
+            s.start()
+            try:
+                handles = [
+                    s.submit(b"p%d" % i, b"m", b"s") for i in range(6)
+                ]
+                assert s.wait_many(handles, timeout=10) == [True] * 6
+            finally:
+                s.stop()
+        assert san.race_report() == "", (seed, san.race_report())

@@ -201,7 +201,7 @@ def test_host_consensus_rung_survives_a_dead_device():
         with pytest.raises(VerifydRejectedError):
             c.verify(*make_lanes(2, seed=9), klass=protocol.CLASS_RPC)
         c.close()
-        assert srv.host_direct_lanes == 4
+        assert srv.stats()["host_direct_lanes"] == 4
         stats = srv.tenant_stats()["chain-a"]
         assert stats["host_direct"] == 4
         assert stats["sheds"] == 1
@@ -252,7 +252,7 @@ def test_shrink_shares_rung_host_directs_consensus_past_share():
             *make_lanes(3, seed=4, bad={1}), klass=protocol.CLASS_CONSENSUS
         )
         assert got == [True, False, True]
-        assert srv.host_direct_lanes == 3
+        assert srv.stats()["host_direct_lanes"] == 3
         c2.close()
         gate.set()
         t1.join(timeout=10)
@@ -308,9 +308,10 @@ def test_device_fault_mid_dispatch_zero_silent_drops():
         for i, (got, bad) in results.items():
             want = [j not in bad for j in range(3)]
             assert got == want, (i, got)
-        sched = srv.scheduler
-        assert sched.flush_errors >= 1
-        assert sched.fallback_flushes >= 1  # the fault was absorbed
+        # flush threads may still be unwinding: locked snapshot only
+        sstats = srv.scheduler.stats()
+        assert sstats["flush_errors"] >= 1
+        assert sstats["fallback_flushes"] >= 1  # the fault was absorbed
     finally:
         srv.stop()
 
@@ -330,7 +331,7 @@ def test_permanent_device_fault_every_flush_still_answers():
                 True,
             ]
         c.close()
-        assert srv.scheduler.fallback_flushes >= 3
+        assert srv.scheduler.stats()["fallback_flushes"] >= 3
     finally:
         srv.stop()
 
@@ -441,7 +442,7 @@ def test_kill_and_restart_under_continuous_load():
         assert snapshot.count("ok") >= 4  # service genuinely resumed
         assert "bad" not in snapshot
         # post-restart requests land on the new instance
-        assert srv2.requests_served >= 1
+        assert srv2.stats()["requests_served"] >= 1
     finally:
         stop_flag.set()
         for t in threads:
@@ -533,8 +534,13 @@ def test_tenant_flood_victim_p99_and_explicit_sheds():
         with flood_mtx:
             sheds = flood_outcomes.count("shed")
         # the flood genuinely overran its budget AND every overrun was
-        # an explicit wire status (the aggressor loop asserts the code)
-        assert sheds >= 1
+        # an explicit wire status (the aggressor loop asserts the code).
+        # Under tpusan the instrumented flood threads are too slow to
+        # overrun anything, so the load threshold only applies bare.
+        from tendermint_tpu.libs import sanitizer
+
+        if not sanitizer.hb_enabled():
+            assert sheds >= 1
         stats = srv.tenant_stats()
         assert stats["flood"]["sheds"] == sheds
         assert stats.get("victim", {}).get("sheds", 0) == 0
@@ -583,7 +589,7 @@ def test_trace_proves_admission_during_inflight_dispatch():
         # wait for the second group's admission to be traced
         deadline = time.monotonic() + 5
         while (
-            srv.scheduler.inflight_admissions < 1
+            srv.scheduler.stats()["inflight_admissions"] < 1
             and time.monotonic() < deadline
         ):
             time.sleep(0.005)
