@@ -584,6 +584,113 @@ def run_verifyd_tenants(beat) -> dict:
     }
 
 
+def run_verifyd_shm(beat) -> dict:
+    """Zero-copy ingress A/B (verifyd/shm.py): the identical batch rides
+    the shared-memory slab ring vs the TCP proto3 codec against the same
+    in-process verifyd, at 1k and BENCH_SHM_LANES (default 8k) lanes.
+    The verifier is a noop over synthetic lanes — declared as
+    ``verify: noop`` in the fragment — so the deltas isolate transport +
+    codec cost from kernel speed, and the section runs without jax."""
+    import threading  # noqa: F401  (keeps import style with siblings)
+
+    from tendermint_tpu.verifyd import protocol
+    from tendermint_tpu.verifyd.client import VerifydClient
+    from tendermint_tpu.verifyd.server import VerifydServer
+
+    rounds = env_int("BENCH_SHM_ROUNDS", 12)
+    big = env_int("BENCH_SHM_LANES", 8192)
+    sizes = sorted({min(1024, big), big})
+
+    def make_lanes(n):
+        # the noop verifier never reads the bytes; distinct msgs keep
+        # the scheduler's coalescing keys distinct
+        return (
+            [i.to_bytes(4, "little") * 8 for i in range(n)],
+            [b"shm-lane-%08d" % i for i in range(n)],
+            [b"\x05" * 64] * n,
+        )
+
+    def noop(pks, msgs, sigs):
+        return [True] * len(pks)
+
+    srv = VerifydServer(
+        verify_fn=noop,
+        max_batch=big,
+        max_delay=0.0005,
+        admission_cap=4 * big,
+        max_pending=4 * big,
+        shm="on",
+    )
+    srv.start()
+    host, port = srv.address
+    addr = f"{host}:{port}"
+    out = {"verify": "noop", "rounds": rounds, "sizes": {}}
+    try:
+        for n in sizes:
+            pks, msgs, sigs = make_lanes(n)
+            per_mode = {}
+            for mode in ("shm", "tcp"):
+                beat("mode=%s lanes=%d rounds=%d" % (mode, n, rounds))
+                c = VerifydClient(
+                    addr,
+                    shm="on" if mode == "shm" else "off",
+                    fallback=False,
+                )
+                try:
+                    oks = c.verify(
+                        pks, msgs, sigs, klass=protocol.CLASS_CONSENSUS
+                    )
+                    if not all(oks):
+                        raise AssertionError("noop verify must pass")
+                    if mode == "shm" and c.transport != "shm":
+                        per_mode[mode] = {
+                            "error": "shm negotiation failed (rode %s)"
+                            % c.transport
+                        }
+                        continue
+                    lat = []
+                    for _ in range(rounds):
+                        t0 = time.perf_counter()
+                        c.verify(
+                            pks, msgs, sigs, klass=protocol.CLASS_CONSENSUS
+                        )
+                        lat.append(time.perf_counter() - t0)
+                    lat.sort()
+                    stats = c.stats()
+                    per_mode[mode] = {
+                        "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+                        "p99_ms": round(
+                            lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+                            * 1e3,
+                            3,
+                        ),
+                        "sigs_per_s": round(rounds * n / sum(lat), 1),
+                        "transport": stats["transport"],
+                        "shm_fallbacks": stats["shm_fallbacks"],
+                    }
+                    if mode == "shm":
+                        per_mode[mode]["codec_bytes_avoided"] = stats[
+                            "shm_bytes_avoided"
+                        ]
+                finally:
+                    c.close()
+            entry = dict(per_mode)
+            if "p50_ms" in per_mode.get("shm", {}) and "p50_ms" in per_mode.get(
+                "tcp", {}
+            ):
+                entry["p50_delta_ms"] = round(
+                    per_mode["tcp"]["p50_ms"] - per_mode["shm"]["p50_ms"], 3
+                )
+            out["sizes"][str(n)] = entry
+        out["server"] = {
+            k: srv.stats()[k]
+            for k in ("shm_lanes", "shm_torn_slabs", "shm_fallbacks")
+        }
+    finally:
+        srv.stop()
+    return {"verifyd_shm": out}
+
+
 def run_light_serve(beat) -> dict:
     """PR 9 serving-tier benchmark: an in-process lightd (selector event
     loop + verified-header cache) under BENCH_LIGHT_SERVE_CLIENTS
@@ -910,6 +1017,16 @@ _ALL = (
             ("BENCH_TENANTS_FLOODS", 4, 1),
         ),
         skip_env=("BENCH_SKIP_VERIFYD_TENANTS",),
+    ),
+    Section(
+        "verifyd_shm",
+        run_verifyd_shm,
+        needs_jax=False,
+        degrade=(
+            ("BENCH_SHM_LANES", 8192, 1024),
+            ("BENCH_SHM_ROUNDS", 12, 4),
+        ),
+        skip_env=("BENCH_SKIP_VERIFYD_SHM",),
     ),
     Section(
         "light_serve",

@@ -28,6 +28,15 @@ makes that reasoning mechanical for ``verifyd/protocol.py`` and
   a decode-side ``x.attr = x.attr or DEFAULT`` normalization, a
   pre-loop ``attr = DEFAULT`` local, or the dataclass field default
   being the same constant.
+- TPW005 — slab-header codec asymmetry (``verifyd/shm.py``): the
+  shared-memory slab header is a fixed layout named by ``SLAB_OFF_*``
+  constants, and ``pack_header``/``unpack_header`` must both touch
+  every one of them — a field packed but never unpacked (or vice
+  versa) is the binary-layout twin of the zero-omission bugs above:
+  the reader silently sees stale bytes from the slot's previous
+  occupant. Referencing an undefined ``SLAB_OFF_`` name is flagged
+  too (both sides must name the SAME module-level offset, which is
+  what makes the offsets provably matching).
 
 Enum families are discovered structurally from the ``X_NAMES =
 {CONST: "name"}`` dicts the protocol modules already maintain, so new
@@ -41,7 +50,7 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from scripts.analysis.core import Checker, Finding, Module, dotted_name, parent_map
 
-_WIRE_FILES = ("verifyd/protocol.py", "libs/grpc.py")
+_WIRE_FILES = ("verifyd/protocol.py", "libs/grpc.py", "verifyd/shm.py")
 _EMIT_FNS = {"_put_varint", "_varint", "put_varint", "_tag", "_put_tag"}
 _STR_EMIT_FNS = {"encode_string_field", "encode_bytes_field"}
 
@@ -78,6 +87,7 @@ class WireCompatChecker(Checker):
         "TPW002": "asymmetric +1/-1 wire shift between encode and decode",
         "TPW003": "grpc-status trailer emitted conditionally on truthiness",
         "TPW004": "default-omitted string field never re-established on decode",
+        "TPW005": "slab-header field not covered by both pack_header and unpack_header",
     }
 
     def check_module(self, module: Module) -> Iterator[Finding]:
@@ -89,6 +99,7 @@ class WireCompatChecker(Checker):
         yield from self._check_shift_symmetry(module, families)
         yield from self._check_grpc_status(module)
         yield from self._check_default_omission(module)
+        yield from self._check_slab_header_symmetry(module, consts)
 
     # --- enum discovery ------------------------------------------------------
 
@@ -314,6 +325,73 @@ class WireCompatChecker(Checker):
                     break
                 if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     break
+
+    # --- TPW005: slab-header pack/unpack symmetry ------------------------------
+
+    _SLAB_OFF_PREFIX = "SLAB_OFF_"
+    _SLAB_CODEC_FNS = ("pack_header", "unpack_header")
+
+    def _check_slab_header_symmetry(
+        self, module: Module, consts: Dict[str, int]
+    ) -> Iterator[Finding]:
+        offsets = {
+            n for n in consts if n.startswith(self._SLAB_OFF_PREFIX)
+        }
+        fns: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(module.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in self._SLAB_CODEC_FNS
+            ):
+                fns.setdefault(node.name, node)
+        if not offsets and not fns:
+            return  # not a slab-codec module
+        for name in self._SLAB_CODEC_FNS:
+            if name not in fns:
+                yield Finding(
+                    module.rel,
+                    1,
+                    "TPW005",
+                    f"slab-header offsets are defined but '{name}' is "
+                    "missing; the layout has no matching "
+                    f"{'reader' if name == 'unpack_header' else 'writer'}",
+                )
+        if len(fns) < len(self._SLAB_CODEC_FNS):
+            return
+        refs = {
+            name: {
+                n.id
+                for n in ast.walk(fn)
+                if isinstance(n, ast.Name)
+                and n.id.startswith(self._SLAB_OFF_PREFIX)
+            }
+            for name, fn in fns.items()
+        }
+        for name, fn in sorted(fns.items()):
+            for missing in sorted(offsets - refs[name]):
+                other = (
+                    self._SLAB_CODEC_FNS[1]
+                    if name == self._SLAB_CODEC_FNS[0]
+                    else self._SLAB_CODEC_FNS[0]
+                )
+                yield Finding(
+                    module.rel,
+                    fn.lineno,
+                    "TPW005",
+                    f"slab-header field {missing} is never touched by "
+                    f"'{name}' (it {'is' if missing in refs[other] else 'is not'} "
+                    f"covered by '{other}'); a one-sided field reads as "
+                    "stale bytes from the slot's previous occupant",
+                )
+            for unknown in sorted(refs[name] - offsets):
+                yield Finding(
+                    module.rel,
+                    fn.lineno,
+                    "TPW005",
+                    f"'{name}' references {unknown}, which is not a "
+                    "module-level slab offset constant; both codec sides "
+                    "must name the same SLAB_OFF_* layout",
+                )
 
     # --- TPW004: default-omitted string fields --------------------------------
 

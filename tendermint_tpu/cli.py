@@ -202,9 +202,17 @@ def cmd_start(args) -> int:
     try:
         node = _build_node(cfg)
         node.start()
+        verify_banner = ""
+        if cfg.ops.verify_remote:
+            from tendermint_tpu.verifyd.client import remote_transport
+
+            transport = remote_transport() or "tcp"
+            verify_banner = (
+                f", verify {cfg.ops.verify_remote} via {transport}"
+            )
         print(
             f"node {node.node_key.node_id} started "
-            f"(p2p {cfg.p2p.laddr}, rpc {cfg.rpc.laddr})",
+            f"(p2p {cfg.p2p.laddr}, rpc {cfg.rpc.laddr}{verify_banner})",
             flush=True,
         )
         last_height = -1
@@ -544,6 +552,7 @@ def cmd_verifyd(args) -> int:
         max_tenants=args.max_tenants,
         metrics=VerifydMetrics(reg),
         evloop_metrics=EvloopMetrics(reg),
+        shm=None if args.shm == "auto" else args.shm,
     )
     metrics_server = None
     if args.metrics:
@@ -561,12 +570,14 @@ def cmd_verifyd(args) -> int:
     if metrics_server is not None:
         metrics_server.start()
     shost, sport = server.address
+    shm_banner = server.shm_socket_path or "off"
     print(
         f"verifyd serving on {shost}:{sport} "
         f"(max_batch={server.max_batch}, max_delay={args.max_delay}s, "
         f"admission_cap={args.admission_cap}, "
         f"continuous={server.scheduler.continuous}, "
-        f"tenant_cap={args.tenant_cap})",
+        f"tenant_cap={args.tenant_cap}, "
+        f"shm={shm_banner})",
         flush=True,
     )
     try:
@@ -1112,6 +1123,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--max-tenants", type=int, default=16,
         help="distinct tenant metric/budget buckets; overflow shares one",
+    )
+    p.add_argument(
+        "--shm", choices=("auto", "on", "off"), default="auto",
+        help="zero-copy shared-memory ingress for co-located callers "
+        "(verifyd/shm.py): auto follows TENDERMINT_TPU_SHM; off is "
+        "pure TCP",
     )
     p.add_argument(
         "--metrics", default="", metavar="HOST:PORT",

@@ -124,6 +124,11 @@ class OpsConfig:
     # "auto" (on for tpu/axon backends), "on", or "off". Empty defers
     # to the TENDERMINT_TPU_RESIDENT env var.
     resident_tables: str = ""
+    # Shared-memory slab-ring transport to a co-located verifyd
+    # (verifyd/shm.py): "auto" (negotiate when server and node share a
+    # host), "on", or "off" (pure TCP). Empty defers to the
+    # TENDERMINT_TPU_SHM env var.
+    verify_shm: str = ""
 
 
 @dataclass
@@ -204,6 +209,7 @@ class Config:
             verify_tenant=self.ops.verify_tenant,
             mesh_devices=self.ops.mesh_devices,
             resident_tables=self.ops.resident_tables,
+            verify_shm=self.ops.verify_shm,
         )
 
     # --- TOML ---------------------------------------------------------------

@@ -536,6 +536,18 @@ class VerifyScheduler:
         ):
             with tracing.span("sched_assemble", lanes=len(batch)) as asp:
                 for p in batch:
+                    # Zero-copy ingress (verifyd/shm.py) submits lanes as
+                    # memoryviews into a client-owned slab; they stay
+                    # views while queued (no copy on the ingest path) and
+                    # materialise exactly once here, where coalescing
+                    # needs hashable keys and the verify backends expect
+                    # bytes. After this point the slab may be reused.
+                    if type(p.msg) is memoryview:
+                        p.msg = p.msg.tobytes()
+                    if type(p.pubkey) is memoryview:
+                        p.pubkey = p.pubkey.tobytes()
+                    if type(p.sig) is memoryview:
+                        p.sig = p.sig.tobytes()
                     key = (p.pubkey, p.msg, p.sig)
                     idx = index.get(key)
                     if idx is None:

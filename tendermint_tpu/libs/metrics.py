@@ -614,6 +614,28 @@ class VerifydMetrics(_NopMixin):
             "Consensus lanes verified on the host oracle by the"
             " brownout ladder's shrink_shares/host_consensus rungs.",
         )
+        # shared-memory slab-ring ingress (verifyd/shm.py)
+        self.shm_lanes = reg.counter(
+            _name(s, "shm_lanes_total"),
+            "Signature lanes that arrived through the shared-memory"
+            " slab-ring transport (before admission).",
+        )
+        self.shm_fallbacks = reg.counter(
+            _name(s, "shm_fallbacks_total"),
+            "Shm attach/session failures that pushed a caller back onto"
+            " the TCP path.",
+        )
+        self.shm_torn_slabs = reg.counter(
+            _name(s, "shm_torn_slabs_total"),
+            "Committed slabs rejected by the seqlock generation check"
+            " (writer died or raced mid-write); each one is answered"
+            " with an explicit INVALID, never dropped silently.",
+        )
+        self.shm_ring_occupancy = reg.gauge(
+            _name(s, "shm_ring_occupancy"),
+            "Lanes committed to slab rings and not yet drained into the"
+            " scheduler, summed over live shm sessions.",
+        )
 
 
 class EvloopMetrics(_NopMixin):

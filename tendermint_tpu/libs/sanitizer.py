@@ -56,6 +56,7 @@ import os
 import queue as _queue_mod
 import random
 import re
+import selectors
 import socket
 import sys
 import threading
@@ -77,6 +78,7 @@ _orig_rlock = None
 _orig_sleep = None
 _orig_recv = None
 _orig_accept = None
+_orig_select = None
 
 # hb-mode patch originals
 _hb_on = False
@@ -100,7 +102,16 @@ _known_sites: Set[str] = set()
 # per-thread and per-lock clock without having to reach into other
 # threads' TLS.
 _hb_gen = 0
-_next_tid = 0
+# Dense tids: 0 is reserved for the main thread, children preassigned
+# at start() draw 1, 2, ... in schedule order. Threads the sanitizer
+# never saw start (leaked pools from earlier tests, foreign daemons)
+# draw from a disjoint high range so their first-sync timing can never
+# shift a participant's tid — replay reports stay byte-stable even in
+# a full-suite process with stragglers.
+_MAIN_TID = 0
+_FOREIGN_TID_BASE = 10000
+_next_tid = 1
+_next_foreign_tid = _FOREIGN_TID_BASE
 #: (id(obj), attr) -> {"cls", "attr", "w": (tid, clock, acc)|None,
 #:                     "r": {tid: (clock, acc)}}
 #: where acc = (op, thread-disp, stack, held-lock-sites)
@@ -251,6 +262,14 @@ def _alloc_tid() -> int:
     return tid
 
 
+def _alloc_foreign_tid() -> int:
+    global _next_foreign_tid
+    with _state_mtx:
+        tid = _next_foreign_tid
+        _next_foreign_tid += 1
+    return tid
+
+
 def _hb_state() -> _HBThread:
     """Per-thread hb state, lazily (re)created per generation. Thread
     ids are dense ints preassigned by the parent at ``start()`` (so the
@@ -264,8 +283,10 @@ def _hb_state() -> _HBThread:
     pre = getattr(cur, "_tpusan_tid", None) if cur is not None else None
     if pre is not None and pre[0] == _hb_gen:
         tid = pre[1]
+    elif cur is not None and cur is threading.main_thread():
+        tid = _MAIN_TID
     else:
-        tid = _alloc_tid()
+        tid = _alloc_foreign_tid()
     st = _HBThread()
     st.tid = tid
     st.gen = _hb_gen
@@ -688,6 +709,15 @@ def _accept(self, *args, **kwargs):
         return _orig_accept(self, *args, **kwargs)
 
 
+def _select(self, *args, **kwargs):
+    # event loops park here with second-scale timeouts; without the
+    # release an evloop participant would sit on the explore run token
+    # for the whole select and every schedule decision would degrade
+    # through the stall failsafe
+    with _explorer_blocking():
+        return _orig_select(self, *args, **kwargs)
+
+
 # --- hb-mode thread / condition patches --------------------------------------
 
 
@@ -1055,7 +1085,8 @@ def install(mode: Optional[str] = None) -> None:
     Only locks created AFTER install are sanitized — install before
     importing the code under test (tests/conftest.py does)."""
     global _installed, _orig_lock, _orig_rlock
-    global _orig_sleep, _orig_recv, _orig_accept, _explore_seed
+    global _orig_sleep, _orig_recv, _orig_accept, _orig_select
+    global _explore_seed
     if mode is None:
         mode = os.environ.get(ENV, "") or "1"
     hb, seed = _parse_mode(mode)
@@ -1070,6 +1101,8 @@ def install(mode: Optional[str] = None) -> None:
         socket.socket.recv = _recv
         _orig_accept = socket.socket.accept
         socket.socket.accept = _accept
+        _orig_select = selectors.DefaultSelector.select
+        selectors.DefaultSelector.select = _select
         _installed = True
     if hb:
         _enable_hb()
@@ -1087,6 +1120,7 @@ def uninstall() -> None:
     time.sleep = _orig_sleep
     socket.socket.recv = _orig_recv
     socket.socket.accept = _orig_accept
+    selectors.DefaultSelector.select = _orig_select
     _explore_seed = None
     _installed = False
 
@@ -1098,7 +1132,7 @@ def installed() -> bool:
 def reset() -> None:
     """Drop recorded edges/violations/races (test isolation). Bumping
     the generation lazily invalidates every thread and lock clock."""
-    global _hb_gen, _next_tid
+    global _hb_gen, _next_tid, _next_foreign_tid
     with _state_mtx:
         _edges.clear()
         _io_violations.clear()
@@ -1106,7 +1140,8 @@ def reset() -> None:
         _vars.clear()
         _races.clear()
         _hb_gen += 1
-        _next_tid = 0
+        _next_tid = 1
+        _next_foreign_tid = _FOREIGN_TID_BASE
 
 
 def _find_cycles(

@@ -110,6 +110,10 @@ class NodeConfig:
     # the TENDERMINT_TPU_RESIDENT env var): "auto" | "on" | "off",
     # "" defers to the env var (ops/resident.py).
     resident_tables: str = ""
+    # Shared-memory slab-ring transport to a co-located verifyd
+    # ([ops] verify_shm / the TENDERMINT_TPU_SHM env var): "auto" |
+    # "on" | "off", "" defers to the env var (verifyd/shm.py).
+    verify_shm: str = ""
 
 
 class Node:
@@ -359,6 +363,13 @@ class Node:
             _vclient.set_remote_addr(config.verify_remote)
             if config.verify_tenant:
                 _vclient.set_remote_tenant(config.verify_tenant)
+        # Zero-copy ingress mode for that remote (verifyd/shm.py):
+        # auto/on/off, applied process-wide so the cached client
+        # negotiates (or refuses) the slab-ring transport accordingly.
+        if config.verify_shm:
+            from tendermint_tpu.verifyd import shm as _vshm
+
+            _vshm.set_shm_mode(config.verify_shm)
 
         # --- pools + executor (node.go:258-297) ------------------------------
         self.mempool = TxMempool(

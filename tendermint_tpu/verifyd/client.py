@@ -46,6 +46,7 @@ from typing import Callable, List, Optional, Sequence
 from tendermint_tpu.libs import tracing
 from tendermint_tpu.libs.grpc import GrpcChannel, GrpcError, H2ProtocolError
 from tendermint_tpu.verifyd import protocol
+from tendermint_tpu.verifyd import shm as shm_transport
 from tendermint_tpu.verifyd.protocol import (
     ALGO_ED25519,
     ALGO_SR25519,
@@ -144,6 +145,7 @@ class VerifydClient:
         tenant: str = DEFAULT_TENANT,
         shed_retries: int = 2,
         shed_backoff: float = 0.02,
+        shm: Optional[str] = None,
     ):
         host, _, port = addr.rpartition(":")
         if not host or not port.isdigit():
@@ -172,6 +174,52 @@ class VerifydClient:
         self.fallback_calls = 0
         self.shed_retries_used = 0
         self.rejected = {}  # status -> count
+        # zero-copy ingress: negotiated lazily when the server shares
+        # this host and advertises an endpoint (TENDERMINT_TPU_SHM /
+        # [ops] verify_shm / the shm param; off restores pure TCP)
+        if shm is not None and shm not in ("auto", "on", "off"):
+            raise ValueError(f"bad shm mode {shm!r}")
+        self._shm_param = shm
+        self._shm_local = shm_transport.is_local(host)
+        self._shm_mtx = threading.Lock()
+        self._shm: Optional[shm_transport.ShmClientTransport] = None  # guarded-by: _shm_mtx
+        self._shm_next_retry = 0.0  # guarded-by: _shm_mtx
+        self.shm_calls = 0  # guarded-by: _shm_mtx
+        self.shm_fallbacks = 0  # guarded-by: _shm_mtx
+        self.shm_lanes = 0  # guarded-by: _shm_mtx
+        self.shm_bytes_avoided = 0  # guarded-by: _shm_mtx
+
+    def shm_mode(self) -> str:
+        """Effective transport mode: constructor param beats the
+        process-wide config/env resolution (re-read per call so
+        ``set_shm_mode`` applies to cached clients too)."""
+        return self._shm_param or shm_transport.shm_mode()
+
+    @property
+    def transport(self) -> str:
+        """The negotiated transport right now: ``shm`` once a slab-ring
+        session is live, else ``tcp``."""
+        with self._shm_mtx:
+            t = self._shm
+        return "shm" if (t is not None and t.alive) else "tcp"
+
+    def stats(self) -> dict:
+        """Counter snapshot (CLI banner, bench, tests)."""
+        with self._shm_mtx:
+            shm_stats = {
+                "shm_calls": self.shm_calls,
+                "shm_fallbacks": self.shm_fallbacks,
+                "shm_lanes": self.shm_lanes,
+                "shm_bytes_avoided": self.shm_bytes_avoided,
+            }
+        return {
+            "transport": self.transport,
+            "calls": self.calls,
+            "transport_retries": self.transport_retries,
+            "fallback_calls": self.fallback_calls,
+            "shed_retries_used": self.shed_retries_used,
+            **shm_stats,
+        }
 
     def _acquire(self) -> GrpcChannel:
         with self._available:
@@ -199,6 +247,10 @@ class VerifydClient:
             self._available.notify()
 
     def close(self) -> None:
+        with self._shm_mtx:
+            shm, self._shm = self._shm, None
+        if shm is not None:
+            shm.close()
         with self._available:
             for ch in self._pool:
                 try:
@@ -208,6 +260,97 @@ class VerifydClient:
             self._pool.clear()
             self._free.clear()
             self._available.notify_all()
+
+    # --- shm negotiation -----------------------------------------------------
+
+    def _maybe_shm(self) -> Optional[shm_transport.ShmClientTransport]:
+        mode = self.shm_mode()
+        if mode == "off" or not self._shm_local:
+            return None
+        with self._shm_mtx:
+            t = self._shm
+            if t is not None:
+                if t.alive:
+                    return t
+                self._shm = None  # dead session (server restart): renegotiate
+            now = time.monotonic()
+            if now < self._shm_next_retry:
+                return None
+            self._shm_next_retry = now + 1.0
+            ep = shm_transport.read_endpoint(self._port)
+            if ep is None:
+                # no advert = the server runs TCP-only (or isn't up):
+                # that's negotiation working, not a fallback — unless
+                # the caller demanded shm outright
+                if mode == "on":
+                    self.shm_fallbacks += 1
+                return None
+            try:
+                new = shm_transport.ShmClientTransport(
+                    ep["socket"], ep["token"]
+                )
+            except shm_transport.ShmError:
+                self.shm_fallbacks += 1
+                return None
+            self._shm = new
+            return new
+
+    def _call_transport(
+        self, req: VerifyRequest, timeout: float
+    ) -> protocol.VerifyResponse:
+        """One unary exchange over the best negotiated transport: slab
+        ring when live, TCP otherwise. ShmBusy (ring full) pushes just
+        this request onto TCP — that is the backpressure path admission
+        control meters; any other shm failure drops the session and
+        renegotiates later."""
+        t = self._maybe_shm()
+        if t is not None:
+            try:
+                resp = t.call(req, timeout=timeout)
+            except shm_transport.ShmBusy:
+                with self._shm_mtx:
+                    self.shm_fallbacks += 1
+            except shm_transport.ShmError:
+                with self._shm_mtx:
+                    self.shm_fallbacks += 1
+                    if self._shm is t:
+                        self._shm = None
+                t.close()
+            else:
+                with self._shm_mtx:
+                    self.shm_calls += 1
+                    self.shm_lanes += len(req)
+                    self.shm_bytes_avoided += protocol.encoded_request_size(
+                        req
+                    )
+                self.calls += 1
+                return resp
+        if len(req) <= protocol.MAX_LANES:
+            return self.call(req, timeout=timeout)
+        # the TCP codec caps a request at MAX_LANES; shm super-batches
+        # that fell back split here and merge their verdicts
+        verdicts: List[bool] = []
+        depth = 0
+        for start in range(0, len(req), protocol.MAX_LANES):
+            end = start + protocol.MAX_LANES
+            sub = VerifyRequest(
+                kind=req.kind,
+                klass=req.klass,
+                deadline_ms=req.deadline_ms,
+                algo=req.algo,
+                pks=list(req.pks[start:end]),
+                msgs=list(req.msgs[start:end]),
+                sigs=list(req.sigs[start:end]),
+                tenant=req.tenant,
+            )
+            resp = self.call(sub, timeout=timeout)
+            if resp.status != STATUS_OK:
+                return resp
+            verdicts.extend(resp.verdicts)
+            depth = max(depth, resp.queue_depth)
+        return protocol.VerifyResponse(
+            status=STATUS_OK, verdicts=verdicts, queue_depth=depth
+        )
 
     # --- calls --------------------------------------------------------------
 
@@ -307,7 +450,7 @@ class VerifydClient:
                     # transport grace past the verify deadline: the
                     # server answers DEADLINE_EXCEEDED at exactly
                     # `deadline`; the wire timeout must not race that
-                    resp = self.call(req, timeout=remaining + 0.5)
+                    resp = self._call_transport(req, timeout=remaining + 0.5)
                 except VerifydUnavailableError:
                     if not self.fallback:
                         raise
@@ -408,3 +551,18 @@ def remote_backend() -> Optional[Callable[..., List[bool]]]:
             _remote_client = VerifydClient(addr, tenant=_remote_tenant)
             _remote_client_key = key
         return _remote_client.verify
+
+
+def remote_transport() -> Optional[str]:
+    """Negotiated transport of the process-wide remote client
+    (``"shm"`` | ``"tcp"``), or None when no remote is configured.
+    Probes shm negotiation eagerly so a start-up banner reports the
+    transport the first verify call will actually ride."""
+    if remote_backend() is None:
+        return None
+    with _remote_mtx:
+        client = _remote_client
+    if client is None:
+        return None
+    client._maybe_shm()
+    return client.transport

@@ -155,6 +155,40 @@ def encode_request(req: VerifyRequest) -> bytes:
     return bytes(out)
 
 
+def _varint_size(value: int) -> int:
+    size = 1
+    while value > 0x7F:
+        value >>= 7
+        size += 1
+    return size
+
+
+def encoded_request_size(req: VerifyRequest) -> int:
+    """Exact byte length ``encode_request(req)`` would produce, computed
+    without materialising the frame.  The shm transport uses this to
+    report ``codec_bytes_avoided`` honestly — it is the TCP codec cost
+    the slab path skipped, per the same zero-omission rules the encoder
+    applies (klass rides +1, default tenant omitted)."""
+    size = 0
+    if req.kind:
+        size += 1 + _varint_size(req.kind)
+    size += 1 + _varint_size(req.klass + 1)
+    if req.deadline_ms:
+        size += 1 + _varint_size(req.deadline_ms)
+    if req.algo:
+        size += 1 + _varint_size(req.algo)
+    for pk, msg, sig in zip(req.pks, req.msgs, req.sigs):
+        lane = 0
+        for part in (pk, msg, sig):
+            if part:  # empty bytes fields are omitted entirely
+                lane += 1 + _varint_size(len(part)) + len(part)
+        size += 1 + _varint_size(lane) + lane
+    if req.tenant and req.tenant != DEFAULT_TENANT:
+        tenant = req.tenant.encode("utf-8")
+        size += 1 + _varint_size(len(tenant)) + len(tenant)
+    return size
+
+
 def decode_request(data: bytes) -> VerifyRequest:
     """Decode + validate; raises ValueError on any malformed input so the
     server can answer STATUS_INVALID instead of crashing a stream."""

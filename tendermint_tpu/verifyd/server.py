@@ -69,6 +69,7 @@ from tendermint_tpu.libs.grpc import GrpcServer, current_conn_tag
 from tendermint_tpu.libs.sanitizer import instrument_attrs
 from tendermint_tpu.libs.metrics import VerifydMetrics
 from tendermint_tpu.verifyd import protocol
+from tendermint_tpu.verifyd import shm as shm_transport
 from tendermint_tpu.verifyd.protocol import (
     ALGO_ED25519,
     ALGO_SR25519,
@@ -372,6 +373,7 @@ class VerifydServer:
         tenant_pin_quota: int = DEFAULT_PIN_QUOTA,
         max_tenants: int = DEFAULT_MAX_TENANTS,
         brownout: Optional[BrownoutController] = None,
+        shm: Optional[str] = None,
     ):
         self.metrics = metrics or VerifydMetrics.nop()
         self.max_delay = max_delay
@@ -416,6 +418,20 @@ class VerifydServer:
         self.deadline_expired = 0  # guarded-by: _stats_mtx
         self.requests_served = 0  # guarded-by: _stats_mtx
         self.host_direct_lanes = 0  # guarded-by: _stats_mtx
+        self.shm_lanes = 0  # guarded-by: _stats_mtx
+        self.shm_torn_slabs = 0  # guarded-by: _stats_mtx
+        self.shm_fallbacks = 0  # guarded-by: _stats_mtx
+        self._evloop_metrics = evloop_metrics
+        # zero-copy ingress: the slab-ring endpoint starts beside the
+        # TCP listener unless the mode (param beats config/env) is off
+        self._shm_mode = shm if shm is not None else shm_transport.shm_mode()
+        if self._shm_mode not in ("auto", "on", "off"):
+            raise ValueError(f"bad shm mode {self._shm_mode!r}")
+        # _shm_endpoint is published by start() and retired by stop()
+        # while handler threads read it per-request; _shm_mtx guards the
+        # reference (methods on a snapshot are called outside the lock)
+        self._shm_mtx = threading.Lock()
+        self._shm_endpoint: Optional[shm_transport.ShmEndpoint] = None
         self._grpc = GrpcServer(
             {VERIFY_PATH: self._handle}, host, port,
             evloop_metrics=evloop_metrics,
@@ -440,13 +456,58 @@ class VerifydServer:
     def start(self) -> None:
         self._scheduler_for(ALGO_ED25519)  # eager: first request is hot
         self._grpc.start()
+        with self._shm_mtx:
+            want_shm = self._shm_mode != "off" and self._shm_endpoint is None
+        if want_shm:
+            ep = shm_transport.ShmEndpoint(
+                self._serve,
+                metrics=self.metrics,
+                evloop_metrics=self._evloop_metrics,
+                on_stat=self._shm_stat,
+            )
+            try:
+                ep.start(self.address[1])
+            except OSError:
+                # no AF_UNIX / unwritable tempdir: TCP-only serving is
+                # strictly correct, so degrade instead of failing start
+                self._shm_stat("shm_fallbacks", 1)
+                ep = None
+            with self._shm_mtx:
+                self._shm_endpoint = ep
 
     def stop(self) -> None:
         self._grpc.stop()
+        # doorbells close before the schedulers so no NEW slab drains
+        # race scheduler teardown; drains already in flight resolve
+        # against the shutdown flush below
+        with self._shm_mtx:
+            ep, self._shm_endpoint = self._shm_endpoint, None
+        if ep is not None:
+            ep.stop()
         with self._sched_mtx:
             scheds, self._schedulers = dict(self._schedulers), {}
         for sched in scheds.values():
             sched.stop()
+
+    @property
+    def shm_socket_path(self) -> str:
+        """Doorbell socket path when the shm endpoint is live ('' when
+        negotiation is off or the endpoint failed to start)."""
+        with self._shm_mtx:
+            ep = self._shm_endpoint
+        return ep.socket_path if ep is not None else ""
+
+    def shm_backlog(self) -> int:
+        """Lanes committed to slab rings but not yet in the scheduler —
+        added to ``load_depth`` so admission and the brownout ladder see
+        shm pressure exactly like TCP pressure."""
+        with self._shm_mtx:
+            ep = self._shm_endpoint
+        return ep.backlog_lanes() if ep is not None else 0
+
+    def _shm_stat(self, field: str, n: int) -> None:
+        with self._stats_mtx:
+            setattr(self, field, getattr(self, field) + n)
 
     def _scheduler_for(self, algo: int) -> VerifyScheduler:
         with self._sched_mtx:
@@ -496,6 +557,8 @@ class VerifydServer:
         — a raw attribute read races the serving path even after a
         client got its response, because the TCP round-trip is not a
         synchronization edge the counters ride on."""
+        with self._shm_mtx:
+            ep = self._shm_endpoint
         with self._stats_mtx:
             return {
                 "requests_served": self.requests_served,
@@ -503,6 +566,10 @@ class VerifydServer:
                 "deadline_expired": self.deadline_expired,
                 "host_direct_lanes": self.host_direct_lanes,
                 "cross_client_flushes": dict(self.cross_client_flushes),
+                "shm_lanes": self.shm_lanes,
+                "shm_torn_slabs": self.shm_torn_slabs,
+                "shm_fallbacks": self.shm_fallbacks,
+                "shm_sessions": ep.session_count() if ep is not None else 0,
             }
 
     def tenant_stats(self) -> Dict[str, Dict[str, int]]:
@@ -611,7 +678,7 @@ class VerifydServer:
         kind_name: str,
         queue_depth: int = 0,
         tenant_label: str = "",
-    ) -> bytes:
+    ) -> protocol.VerifyResponse:
         with tracing.span("verifyd_respond", status=STATUS_NAMES[status]):
             with self._stats_mtx:
                 self.requests_served += 1
@@ -625,13 +692,11 @@ class VerifydServer:
                 self.metrics.tenant_request_seconds.labels(
                     tenant=tenant_label
                 ).observe(time.monotonic() - t0)
-            return protocol.encode_response(
-                protocol.VerifyResponse(
-                    status=status,
-                    verdicts=verdicts,
-                    message=message,
-                    queue_depth=queue_depth,
-                )
+            return protocol.VerifyResponse(
+                status=status,
+                verdicts=verdicts,
+                message=message,
+                queue_depth=queue_depth,
             )
 
     def _shed(
@@ -644,7 +709,7 @@ class VerifydServer:
         t0: float,
         kind_name: str,
         depth: int,
-    ) -> bytes:
+    ) -> protocol.VerifyResponse:
         """Every shed path funnels here: explicit RESOURCE_EXHAUSTED on
         the wire, a reasoned rejection metric per class AND per tenant —
         never a silent drop."""
@@ -678,16 +743,22 @@ class VerifydServer:
         t0: float,
         kind_name: str,
         level: int,
-    ) -> bytes:
+    ) -> protocol.VerifyResponse:
         """host_consensus rung: consensus lanes bypass the device
         scheduler and verify on the host oracle — slower, sound, and
         immune to whatever took the device out."""
         n = len(req)
         _verify_fn, host_fn = self._verify_fns[req.algo]
+        # shm requests hand msgs over as slab memoryviews; the host
+        # oracle path bypasses the scheduler's flush-assembly (where
+        # they normally materialise), so copy them out here
+        msgs = [
+            m.tobytes() if type(m) is memoryview else m for m in req.msgs
+        ]
         with tracing.span(
             "verifyd_host_direct", lanes=n, tenant=ts.label, level=level
         ):
-            verdicts = list(host_fn(req.pks, req.msgs, req.sigs))
+            verdicts = list(host_fn(req.pks, msgs, req.sigs))
         with self._stats_mtx:
             self.host_direct_lanes += n
         with self._tenant_mtx:
@@ -700,16 +771,38 @@ class VerifydServer:
         )
 
     def _handle(self, payload: bytes) -> bytes:
+        """TCP entry point: decode the wire frame, serve, re-encode.
+        The shm drain path skips both codec halves and enters
+        ``_serve`` directly — that is the entire zero-copy win."""
         t0 = time.monotonic()
+        with tracing.span("verifyd_decode", nbytes=len(payload)):
+            try:
+                req = protocol.decode_request(payload)
+            except ValueError as exc:
+                return protocol.encode_response(
+                    self._respond(STATUS_INVALID, [], str(exc), t0, "raw")
+                )
+        # Connection identity for cross-client batching stats. Under
+        # the event loop many connections share few worker threads,
+        # so the transport's per-connection tag is authoritative;
+        # the thread ident covers direct (non-gRPC) handler calls.
+        tag = current_conn_tag(threading.get_ident())
+        return protocol.encode_response(self._serve(req, t0, tag=tag))
+
+    def _serve(
+        self,
+        req: protocol.VerifyRequest,
+        t0: float,
+        tag: Optional[object] = None,
+        on_entries: Optional[Callable[[List[object]], None]] = None,
+    ) -> protocol.VerifyResponse:
+        """Transport-independent serving path: admission, brownout,
+        tenant budgets, enqueue, wait. ``on_entries`` (shm drain) gets
+        the scheduler entries right after submit so the caller can tell
+        whether a deadline response left lanes still holding slab
+        memoryviews (the held-slab reclaim protocol)."""
         kind_name = "raw"
         try:
-            with tracing.span("verifyd_decode", nbytes=len(payload)):
-                try:
-                    req = protocol.decode_request(payload)
-                except ValueError as exc:
-                    return self._respond(
-                        STATUS_INVALID, [], str(exc), t0, kind_name
-                    )
             kind_name = KIND_NAMES[req.kind]
             klass_name = CLASS_NAMES[req.klass]
             ts = self._tenant_for(req.tenant)
@@ -723,8 +816,10 @@ class VerifydServer:
 
             # load_depth counts in-flight lanes too: on the continuous
             # path lanes leave the accumulator while their dispatch
-            # still occupies the device, and admission must see them
-            depth = sched.load_depth()
+            # still occupies the device, and admission must see them.
+            # Committed-but-undrained slab-ring lanes ride on top, so
+            # shm pressure moves the brownout ladder like TCP pressure.
+            depth = sched.load_depth() + self.shm_backlog()
             level, moved = self.brownout.observe(
                 self.admission.pressure(depth)
             )
@@ -792,11 +887,8 @@ class VerifydServer:
             if deadline_s:
                 margin = max(0.001, 0.2 * deadline_s)
                 flush_by = t0 + max(0.0, deadline_s - margin)
-            # Connection identity for cross-client batching stats. Under
-            # the event loop many connections share few worker threads,
-            # so the transport's per-connection tag is authoritative;
-            # the thread ident covers direct (non-gRPC) handler calls.
-            tag = current_conn_tag(threading.get_ident())
+            if tag is None:
+                tag = threading.get_ident()
             try:
                 with tracing.span(
                     "verifyd_enqueue", lanes=n, klass=klass_name,
@@ -820,6 +912,8 @@ class VerifydServer:
             self._track_depth(req.klass, n)
             self._tenant_admit(ts, n)
             self.metrics.lanes.labels(klass=klass_name).inc(n)
+            if on_entries is not None:
+                on_entries(entries)
 
             try:
                 verdicts: List[bool] = []

@@ -1,0 +1,1152 @@
+"""Zero-copy shared-memory ingress for verifyd: a slab-ring transport.
+
+A co-located caller (node, lightd, bench loadgen) normally pays a full
+serialize -> TCP -> deserialize cycle per lane; at the 8192-lane
+super-batches the mesh path wants, the protocol codec is pure overhead.
+This module replaces that cycle with a ring of lane slabs in a
+``multiprocessing.shared_memory`` segment: the client writes each
+request's lanes into a slab ONCE, and the server hands the payload to
+the scheduler as memoryviews into the very same slab — the bytes are
+copied exactly once more, at flush-assembly time, when the verify
+backends need ``bytes`` anyway (``crypto/scheduler.py``).
+
+Topology (one segment per client, created by the client):
+
+    [ control block | slab 0 | slab 1 | ... | slab N-1 ]
+
+The control block carries the ring geometry plus two cursors: HEAD
+(client-advanced commit cursor) and TAIL (server-advanced reclaim
+cursor); both are monotonically increasing slot sequence numbers, so
+``head - tail`` is the number of slabs in flight and slot ``seq % N``
+is reused only after the server has retired every older sequence.
+
+Each slab = a fixed header + a lane table + the lane payload:
+
+    header   pack_header/unpack_header, offsets SLAB_OFF_* (tpulint
+             TPW005 pins pack/unpack symmetry, the shm analogue of the
+             proto3 zero-omission hazards TPW001-004 guard). Header
+             semantics MIRROR the TCP codec (verifyd/protocol.py):
+             ``klass`` is stored +1 so CLASS_CONSENSUS=0 survives a
+             zeroed word (0 = absent = CLASS_RPC), and ``tenant_len``
+             0 means DEFAULT_TENANT, exactly like the omitted field 6.
+    table    ``lanes`` little-endian u32 message lengths
+    payload  per lane: pk (32) + sig (64) + msg (msg_len)
+
+Torn-slab detection is a seqlock: the writer stamps GEN = g-1 (odd =
+write in progress), fills the slab, then publishes GEN2 = g and
+GEN = g (even). The reader accepts a slab only when GEN is even, equal
+to GEN2, and strictly newer than the slot's last retired generation —
+anything else (client died mid-write, cursor corruption) is answered
+with an explicit STATUS_INVALID and counted in
+``tendermint_verifyd_shm_torn_slabs_total``; never a silent drop.
+
+The doorbell is a per-client AF_UNIX socket riding the existing evloop
+(libs/evloop.py): a tiny COMMIT frame per slab gives the server
+selector-level readiness (the pipe-doorbell pattern — the payload
+itself never touches the socket), and responses/FREE frames ride the
+same pipe back. Negotiation: the server advertises
+``{socket, token}`` in a per-port endpoint file under the system temp
+dir; ``VerifydClient`` attaches when it shares a host with the server
+and ``TENDERMINT_TPU_SHM`` (or the ``[ops] verify_shm`` config key)
+resolves to ``auto``/``on``. TCP remains the fallback and the
+cross-host path; ``off`` restores it byte-identically.
+
+Backpressure: committed-but-undrained lanes are reported through
+``ShmEndpoint.backlog_lanes()`` and added to the scheduler's
+``load_depth()`` by the server, so the PR-10 brownout ladder sees slab
+pressure exactly like TCP pressure. A full ring raises ``ShmBusy`` and
+the caller rides TCP for that request — which is precisely the path
+admission control meters.
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import os
+import secrets
+import socket
+import struct
+import tempfile
+import threading
+import time
+from multiprocessing import resource_tracker, shared_memory
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from tendermint_tpu.libs.evloop import EvloopMetrics, EvloopServer
+from tendermint_tpu.libs.sanitizer import instrument_attrs
+from tendermint_tpu.verifyd import protocol
+from tendermint_tpu.verifyd.protocol import (
+    CLASS_NAMES,
+    CLASS_RPC,
+    DEFAULT_TENANT,
+    KIND_NAMES,
+    ALGO_NAMES,
+    MAX_MSG_SIZE,
+    MAX_TENANT_LEN,
+    PUBKEY_SIZE,
+    SIG_SIZE,
+    VerifyRequest,
+    VerifyResponse,
+)
+
+SHM_ENV = "TENDERMINT_TPU_SHM"
+SHM_VERSION = 1
+SHM_MAGIC = 0x54_4D_54_50_55_53_4C_42  # "TMTPUSLB"
+
+# per-request lane cap on the slab path; one 2 MiB slab holds an
+# 8192-lane super-batch of short messages without splitting (the TCP
+# path splits at protocol.MAX_LANES=4096 instead)
+SHM_MAX_LANES = 8192
+
+DEFAULT_NSLABS = 8
+DEFAULT_SLAB_BYTES = 2 << 20
+
+# server-side caps on client-proposed geometry
+MAX_NSLABS = 64
+MAX_SLAB_BYTES = 64 << 20
+MAX_SEGMENT_BYTES = 512 << 20
+
+# --- control block (segment-global) ---------------------------------------
+OFF_MAGIC = 0  # u64
+OFF_VERSION = 8  # u32
+OFF_NSLABS = 12  # u32
+OFF_SLAB_BYTES = 16  # u32
+OFF_HEAD = 24  # u64, client commit cursor (slot sequence number)
+OFF_TAIL = 32  # u64, server reclaim cursor
+CTRL_BYTES = 64
+
+# --- slab header (per slab, offsets relative to the slab base) ------------
+SLAB_OFF_GEN = 0  # u32 seqlock generation; odd = write in progress
+SLAB_OFF_KIND = 4  # u32
+SLAB_OFF_KLASS = 8  # u32, stored +1; 0 = absent -> CLASS_RPC
+SLAB_OFF_DEADLINE_MS = 12  # u32 relative deadline, 0 = none
+SLAB_OFF_ALGO = 16  # u32
+SLAB_OFF_LANES = 20  # u32
+SLAB_OFF_TENANT_LEN = 24  # u32, 0 = DEFAULT_TENANT (zero-omission)
+SLAB_OFF_TENANT = 28  # MAX_TENANT_LEN bytes, utf-8, zero-padded
+SLAB_OFF_GEN2 = 92  # u32 trailing seqlock stamp
+SLAB_HEADER_BYTES = 96
+
+_LANE_FIXED = PUBKEY_SIZE + SIG_SIZE
+
+# doorbell frame types (u32 body length + u8 type, then the body)
+MSG_ATTACH = 1
+MSG_ATTACH_OK = 2
+MSG_ATTACH_ERR = 3
+MSG_COMMIT = 4
+MSG_RESP = 5
+MSG_FREE = 6
+_FRAME_HDR = struct.Struct("<IB")
+_COMMIT_BODY = struct.Struct("<QII")  # seq, slot, lanes
+_RESP_HEAD = struct.Struct("<QIBBIH")  # seq, slot, status, held, depth, msg_len
+_FREE_BODY = struct.Struct("<QI")  # seq, slot
+_MAX_FRAME = 1 << 20
+
+
+class ShmError(ConnectionError):
+    """Shm transport failure; the caller should fall back to TCP."""
+
+
+class ShmBusy(ShmError):
+    """Ring momentarily full; THIS request rides TCP, the session
+    stays up (slow-consumer backpressure surfaces through admission)."""
+
+
+class ShmAttachError(ShmError):
+    """Negotiation/attach failed (stale endpoint file, bad token)."""
+
+
+# --- slab header codec ----------------------------------------------------
+
+
+def pack_header(
+    buf,
+    base: int,
+    *,
+    gen: int,
+    kind: int,
+    klass: int,
+    deadline_ms: int,
+    algo: int,
+    lanes: int,
+    tenant: str = DEFAULT_TENANT,
+) -> None:
+    """Publish a slab header. The caller has already written the lane
+    table + payload and stamped ``stamp_begin``; this writes every
+    header field and the closing seqlock stamps (GEN2 then GEN), which
+    makes the slab visible to the reader. ``klass`` is stored +1 and a
+    default tenant is stored as length 0 — the same zero-omission rules
+    the TCP encoder applies (tpulint TPW005 audits the offset symmetry
+    with ``unpack_header``)."""
+    struct.pack_into("<I", buf, base + SLAB_OFF_KIND, kind)
+    struct.pack_into("<I", buf, base + SLAB_OFF_KLASS, klass + 1)
+    struct.pack_into("<I", buf, base + SLAB_OFF_DEADLINE_MS, deadline_ms)
+    struct.pack_into("<I", buf, base + SLAB_OFF_ALGO, algo)
+    struct.pack_into("<I", buf, base + SLAB_OFF_LANES, lanes)
+    if tenant and tenant != DEFAULT_TENANT:
+        raw = tenant.encode("utf-8")
+        struct.pack_into("<I", buf, base + SLAB_OFF_TENANT_LEN, len(raw))
+        buf[base + SLAB_OFF_TENANT : base + SLAB_OFF_TENANT + len(raw)] = raw
+    else:
+        struct.pack_into("<I", buf, base + SLAB_OFF_TENANT_LEN, 0)
+    # publication order matters: GEN2 first, GEN last — a reader that
+    # sees GEN even must also see GEN2 agree, or the slab is torn
+    struct.pack_into("<I", buf, base + SLAB_OFF_GEN2, gen)
+    struct.pack_into("<I", buf, base + SLAB_OFF_GEN, gen)
+
+
+def unpack_header(buf, base: int) -> dict:
+    """Read and validate a slab header; raises ValueError on a torn or
+    malformed slab (mirrors ``protocol.decode_request`` so the server
+    answers STATUS_INVALID instead of crashing a drain worker)."""
+    (gen,) = struct.unpack_from("<I", buf, base + SLAB_OFF_GEN)
+    (kind,) = struct.unpack_from("<I", buf, base + SLAB_OFF_KIND)
+    (klass_raw,) = struct.unpack_from("<I", buf, base + SLAB_OFF_KLASS)
+    (deadline_ms,) = struct.unpack_from("<I", buf, base + SLAB_OFF_DEADLINE_MS)
+    (algo,) = struct.unpack_from("<I", buf, base + SLAB_OFF_ALGO)
+    (lanes,) = struct.unpack_from("<I", buf, base + SLAB_OFF_LANES)
+    (tenant_len,) = struct.unpack_from("<I", buf, base + SLAB_OFF_TENANT_LEN)
+    (gen2,) = struct.unpack_from("<I", buf, base + SLAB_OFF_GEN2)
+    if gen % 2 == 1 or gen != gen2:
+        raise ValueError(f"torn slab: generation {gen}/{gen2}")
+    # 0 = absent: an old/zeroed header decodes to the same defaults an
+    # omitted proto3 field would (klass rides the ring +1)
+    klass = klass_raw - 1 if klass_raw else CLASS_RPC
+    if kind not in KIND_NAMES:
+        raise ValueError(f"unknown kind {kind}")
+    if klass not in CLASS_NAMES:
+        raise ValueError(f"unknown class {klass}")
+    if algo not in ALGO_NAMES:
+        raise ValueError(f"unknown algo {algo}")
+    if lanes > SHM_MAX_LANES:
+        raise ValueError(f"too many lanes: {lanes} > {SHM_MAX_LANES}")
+    if tenant_len > MAX_TENANT_LEN:
+        raise ValueError(f"tenant name too long: {tenant_len}")
+    if tenant_len:
+        raw = bytes(buf[base + SLAB_OFF_TENANT : base + SLAB_OFF_TENANT + tenant_len])
+        tenant = raw.decode("utf-8", "replace")
+    else:
+        tenant = DEFAULT_TENANT
+    return {
+        "gen": gen,
+        "kind": kind,
+        "klass": klass,
+        "deadline_ms": deadline_ms,
+        "algo": algo,
+        "lanes": lanes,
+        "tenant": tenant,
+    }
+
+
+def stamp_begin(buf, base: int, gen: int) -> None:
+    """Mark a slab write-in-progress (odd generation). A reader that
+    lands here — the writer died mid-fill — sees a torn slab."""
+    struct.pack_into("<I", buf, base + SLAB_OFF_GEN, gen - 1)
+
+
+def slab_bytes_needed(msgs) -> int:
+    """Slab footprint of one request's lanes (header + table + payload)."""
+    n = len(msgs)
+    return SLAB_HEADER_BYTES + 4 * n + n * _LANE_FIXED + sum(len(m) for m in msgs)
+
+
+def pack_lanes(buf, base: int, pks, msgs, sigs) -> None:
+    """Write the lane table + payload for one request into a slab whose
+    capacity the caller has already checked via ``slab_bytes_needed``."""
+    n = len(pks)
+    struct.pack_into(
+        f"<{n}I", buf, base + SLAB_HEADER_BYTES, *(len(m) for m in msgs)
+    )
+    off = base + SLAB_HEADER_BYTES + 4 * n
+    for i in range(n):
+        buf[off : off + PUBKEY_SIZE] = pks[i]
+        off += PUBKEY_SIZE
+        buf[off : off + SIG_SIZE] = sigs[i]
+        off += SIG_SIZE
+        m = msgs[i]
+        if m:
+            buf[off : off + len(m)] = m
+            off += len(m)
+
+
+def unpack_lanes(
+    buf, base: int, lanes: int, slab_bytes: int
+) -> Tuple[List[bytes], List[memoryview], List[bytes]]:
+    """Read one slab's lanes. pks/sigs materialise as bytes (they are
+    tiny and become dict keys downstream); msgs stay memoryviews into
+    the slab — the zero-copy hand-off the scheduler normalises at
+    flush-assembly. Raises ValueError when the lane table walks out of
+    the slab (torn write that passed the generation check is still
+    bounded here)."""
+    table_off = base + SLAB_HEADER_BYTES
+    msg_lens = struct.unpack_from(f"<{lanes}I", buf, table_off)
+    payload = sum(msg_lens) + lanes * _LANE_FIXED
+    if SLAB_HEADER_BYTES + 4 * lanes + payload > slab_bytes:
+        raise ValueError("lane table exceeds slab")
+    for ln in msg_lens:
+        if ln > MAX_MSG_SIZE:
+            raise ValueError(f"lane message too large: {ln}")
+    pks: List[bytes] = []
+    msgs: List[memoryview] = []
+    sigs: List[bytes] = []
+    off = table_off + 4 * lanes
+    for ln in msg_lens:
+        pks.append(bytes(buf[off : off + PUBKEY_SIZE]))
+        off += PUBKEY_SIZE
+        sigs.append(bytes(buf[off : off + SIG_SIZE]))
+        off += SIG_SIZE
+        msgs.append(buf[off : off + ln])
+        off += ln
+    return pks, msgs, sigs
+
+
+# --- mode + endpoint negotiation ------------------------------------------
+
+_MODES = ("auto", "on", "off")
+_mode_mtx = threading.Lock()
+_mode_override = ""
+
+# loopback / wildcard spellings that mean "this host"; a configured
+# remote hostname disables shm even if it happens to resolve locally —
+# cheap and predictable beats a DNS round trip on every client build
+_LOCAL_HOSTS = {"", "localhost", "127.0.0.1", "0.0.0.0", "::1", "::"}
+
+
+def set_shm_mode(mode: str) -> None:
+    """Config-file override (``[ops] verify_shm``); empty string clears
+    back to the environment/default resolution."""
+    global _mode_override
+    if mode and mode not in _MODES:
+        raise ValueError(f"verify_shm must be one of {_MODES}: {mode!r}")
+    with _mode_mtx:
+        _mode_override = mode
+
+
+def shm_mode() -> str:
+    """Effective transport mode: config override beats ``SHM_ENV`` env
+    var beats the default ``auto``. Unknown env spellings resolve to
+    ``auto`` (same forgiving posture as the feature flags in ops/)."""
+    with _mode_mtx:
+        override = _mode_override
+    if override:
+        return override
+    env = os.environ.get(SHM_ENV, "").strip().lower()
+    return env if env in _MODES else "auto"
+
+
+def is_local(host: str) -> bool:
+    host = (host or "").strip().lower()
+    return host in _LOCAL_HOSTS or host == socket.gethostname().lower()
+
+
+def endpoint_path(port: int) -> str:
+    return os.path.join(
+        tempfile.gettempdir(), f"tendermint-tpu-verifyd-{port}.shm"
+    )
+
+
+def advertise(port: int, socket_path: str, token: str) -> str:
+    """Publish the shm endpoint for ``port``: a 0600 JSON file written
+    atomically so a reader never sees a half-written advert."""
+    path = endpoint_path(port)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    payload = json.dumps(
+        {"v": SHM_VERSION, "socket": socket_path, "token": token, "pid": os.getpid()}
+    )
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, payload.encode("utf-8"))
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    return path
+
+
+def read_endpoint(port: int) -> Optional[dict]:
+    try:
+        with open(endpoint_path(port), "r", encoding="utf-8") as fh:
+            ep = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(ep, dict) or ep.get("v") != SHM_VERSION:
+        return None
+    if not ep.get("socket") or not ep.get("token"):
+        return None
+    return ep
+
+
+def retract(port: int, token: str) -> None:
+    """Remove our advert — and only ours: a restarted server on the
+    same port may already have replaced the file with its own."""
+    ep = read_endpoint(port)
+    if ep is not None and ep.get("token") == token:
+        try:
+            os.unlink(endpoint_path(port))
+        except OSError:
+            pass  # advert already gone: retraction is best-effort
+
+
+# one resource-tracker entry exists per PROCESS however many times a
+# segment is mapped, so in-process tests (client + server sides in one
+# interpreter) must unlink/unregister a name exactly once between them
+_unlink_mtx = threading.Lock()
+_unlinked_names: Set[str] = set()
+
+
+def _unlink_quiet(seg: shared_memory.SharedMemory) -> None:
+    """Unlink exactly-once per process: whoever loses the cross-process
+    race still unregisters its own resource-tracker entry so process
+    exit stays warning-free."""
+    name = seg._name  # type: ignore[attr-defined]
+    with _unlink_mtx:
+        if name in _unlinked_names:
+            return
+        _unlinked_names.add(name)
+    try:
+        seg.unlink()
+    except FileNotFoundError:
+        try:
+            resource_tracker.unregister(name, "shared_memory")
+        except Exception:
+            pass  # tracker entry already gone; nothing left to clean
+    except OSError:
+        pass  # segment vanished mid-teardown: the goal state anyway
+
+
+def _close_quiet(seg: shared_memory.SharedMemory) -> None:
+    try:
+        seg.close()
+    except BufferError:
+        # scheduler lanes still hold memoryviews into the slab; the
+        # mapping stays alive until they materialise at flush-assembly,
+        # then the segment is reclaimed with the python objects
+        pass
+    except OSError:
+        pass  # double-close on a torn-down mapping: best-effort
+
+
+def _send_frame(sock: socket.socket, typ: int, body: bytes) -> None:
+    sock.sendall(_FRAME_HDR.pack(len(body), typ) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        got = sock.recv(n)
+        if not got:
+            raise ShmError("doorbell closed")
+        chunks.append(got)
+        n -= len(got)
+    return b"".join(chunks)
+
+
+class _FrameBuf:
+    """Incremental doorbell-frame parser for the evloop side."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[Tuple[int, bytes]]:
+        self._buf += data
+        frames = []
+        while True:
+            if len(self._buf) < _FRAME_HDR.size:
+                return frames
+            length, typ = _FRAME_HDR.unpack_from(self._buf, 0)
+            if length > _MAX_FRAME:
+                raise ValueError(f"doorbell frame too large: {length}")
+            end = _FRAME_HDR.size + length
+            if len(self._buf) < end:
+                return frames
+            frames.append((typ, bytes(self._buf[_FRAME_HDR.size : end])))
+            del self._buf[:end]
+
+
+# --- ring geometry --------------------------------------------------------
+
+
+@instrument_attrs
+class SlabRing:
+    """Geometry + cursor accessors over one mapped segment. All fields
+    are written once at construction; the mutable state lives in the
+    segment itself (HEAD/TAIL words and the slab seqlocks), advanced by
+    exactly one writer each — client for HEAD and the slab bodies,
+    server for TAIL — which is the whole-ring invariant tpusan's hb
+    checker holds the surrounding bookkeeping to."""
+
+    def __init__(self, buf, nslabs: int, slab_bytes: int):
+        self.buf = buf
+        self.nslabs = nslabs
+        self.slab_bytes = slab_bytes
+
+    @classmethod
+    def create(cls, buf, nslabs: int, slab_bytes: int) -> "SlabRing":
+        struct.pack_into("<Q", buf, OFF_MAGIC, SHM_MAGIC)
+        struct.pack_into("<I", buf, OFF_VERSION, SHM_VERSION)
+        struct.pack_into("<I", buf, OFF_NSLABS, nslabs)
+        struct.pack_into("<I", buf, OFF_SLAB_BYTES, slab_bytes)
+        struct.pack_into("<Q", buf, OFF_HEAD, 0)
+        struct.pack_into("<Q", buf, OFF_TAIL, 0)
+        return cls(buf, nslabs, slab_bytes)
+
+    @classmethod
+    def attach(cls, buf, nslabs: int, slab_bytes: int) -> "SlabRing":
+        """Server-side attach: trust nothing the client proposed until
+        the control block agrees and the geometry fits the mapping."""
+        (magic,) = struct.unpack_from("<Q", buf, OFF_MAGIC)
+        (version,) = struct.unpack_from("<I", buf, OFF_VERSION)
+        (got_n,) = struct.unpack_from("<I", buf, OFF_NSLABS)
+        (got_sb,) = struct.unpack_from("<I", buf, OFF_SLAB_BYTES)
+        if magic != SHM_MAGIC or version != SHM_VERSION:
+            raise ValueError("bad segment magic/version")
+        if got_n != nslabs or got_sb != slab_bytes:
+            raise ValueError("segment geometry mismatch")
+        if not (1 <= nslabs <= MAX_NSLABS):
+            raise ValueError(f"nslabs out of range: {nslabs}")
+        if not (SLAB_HEADER_BYTES <= slab_bytes <= MAX_SLAB_BYTES):
+            raise ValueError(f"slab_bytes out of range: {slab_bytes}")
+        need = CTRL_BYTES + nslabs * slab_bytes
+        if need > MAX_SEGMENT_BYTES or len(buf) < need:
+            raise ValueError("segment smaller than advertised ring")
+        return cls(buf, nslabs, slab_bytes)
+
+    def slab_base(self, slot: int) -> int:
+        return CTRL_BYTES + slot * self.slab_bytes
+
+    def head(self) -> int:
+        return struct.unpack_from("<Q", self.buf, OFF_HEAD)[0]
+
+    def set_head(self, v: int) -> None:
+        struct.pack_into("<Q", self.buf, OFF_HEAD, v)
+
+    def tail(self) -> int:
+        return struct.unpack_from("<Q", self.buf, OFF_TAIL)[0]
+
+    def set_tail(self, v: int) -> None:
+        struct.pack_into("<Q", self.buf, OFF_TAIL, v)
+
+
+# --- server side ----------------------------------------------------------
+
+# test hook: when set, called once at the top of every slab drain —
+# the chaos battery uses it to wedge the consumer and prove committed
+# slab lanes are visible in the admission pressure signal
+_TEST_DRAIN_GATE: Optional[Callable[[], None]] = None
+
+
+@instrument_attrs
+class _ShmSession:
+    """Server half of one client's ring: drains committed slabs into
+    ``server._serve`` and retires them in sequence order. COMMIT frames
+    may be drained out of order by the worker pool; TAIL only advances
+    past a contiguous prefix of retired sequences, because slot
+    ``seq % nslabs`` must not be rewritten while any older drain could
+    still read it."""
+
+    def __init__(self, endpoint: "ShmEndpoint", transport, seg, ring: SlabRing):
+        self._endpoint = endpoint
+        self._transport = transport
+        self._seg = seg
+        self._ring = ring
+        self._mtx = threading.Lock()
+        self._closed = False  # guarded-by: _mtx
+        self._backlog = 0  # guarded-by: _mtx
+        self._tail_seq = 0  # guarded-by: _mtx
+        self._retired: Set[int] = set()  # guarded-by: _mtx
+        self._inflight: Set[int] = set()  # guarded-by: _mtx
+        self._last_gen = [0] * ring.nslabs  # guarded-by: _mtx
+
+    # -- commit intake (evloop loop thread) --------------------------------
+
+    def on_commit(self, seq: int, slot: int, lanes: int) -> bool:
+        """Validate + enqueue one committed slab; False aborts the
+        doorbell connection (cursor corruption is not recoverable)."""
+        ring = self._ring
+        if slot != seq % ring.nslabs or lanes > SHM_MAX_LANES:
+            return False
+        with self._mtx:
+            if self._closed:
+                return False
+            if seq < self._tail_seq or seq in self._retired or seq in self._inflight:
+                return False  # replayed or stale sequence
+            self._inflight.add(seq)
+            self._backlog += lanes
+        self._endpoint.occupancy_changed()
+        self._transport.defer(lambda: self._drain(seq, slot, lanes))
+        return True
+
+    # -- drain (worker threads) --------------------------------------------
+
+    def _drain(self, seq: int, slot: int, lanes: int) -> None:
+        gate = _TEST_DRAIN_GATE
+        if gate is not None:
+            gate()
+        endpoint = self._endpoint
+        ring = self._ring
+        base = ring.slab_base(slot)
+        t0 = time.monotonic()
+        gen = 0
+        try:
+            hdr = unpack_header(ring.buf, base)
+            gen = hdr["gen"]
+            with self._mtx:
+                if self._closed:
+                    return
+                stale = gen <= self._last_gen[slot]
+            if stale or hdr["lanes"] != lanes:
+                raise ValueError(
+                    f"torn slab: stale generation {gen}"
+                    if stale
+                    else f"torn slab: lane count {hdr['lanes']} != {lanes}"
+                )
+            pks, msgs, sigs = unpack_lanes(ring.buf, base, lanes, ring.slab_bytes)
+        except ValueError as exc:
+            endpoint.note_torn()
+            self._respond(
+                seq,
+                slot,
+                VerifyResponse(
+                    status=protocol.STATUS_INVALID, message=str(exc)
+                ),
+                held=False,
+            )
+            self._retire(seq, slot, lanes, gen)
+            return
+        req = VerifyRequest(
+            kind=hdr["kind"],
+            klass=hdr["klass"],
+            deadline_ms=hdr["deadline_ms"],
+            algo=hdr["algo"],
+            pks=pks,
+            msgs=msgs,
+            sigs=sigs,
+            tenant=hdr["tenant"],
+        )
+        # lanes are now the scheduler's problem; they stop counting as
+        # ring backlog the moment the serve path (admission included)
+        # sees them, so the pressure signal never double-counts
+        with self._mtx:
+            self._backlog -= lanes
+        endpoint.occupancy_changed()
+        endpoint.note_lanes(lanes)
+        entries: List[object] = []
+        resp = endpoint.serve(req, t0, tag=self, on_entries=entries.extend)
+        # a deadline response can outrun its lanes: the scheduler still
+        # holds memoryviews into this slab until the flush materialises
+        # them, so the slab is handed back HELD and a janitor frees it
+        # once every entry resolved
+        held = any(not e.done.is_set() for e in entries)
+        self._respond(seq, slot, resp, held=held)
+        if held:
+            self._transport.defer(
+                lambda: self._janitor(seq, slot, lanes, gen, entries)
+            )
+        else:
+            self._retire(seq, slot, lanes, gen)
+
+    def _janitor(self, seq, slot, lanes, gen, entries) -> None:
+        for e in entries:
+            if not e.done.wait(timeout=15.0):
+                break  # scheduler wedged; reclaim anyway, bounded wait
+        self._retire(seq, slot, lanes, gen)
+        try:
+            self._transport.write(
+                _FRAME_HDR.pack(_FREE_BODY.size, MSG_FREE)
+                + _FREE_BODY.pack(seq, slot)
+            )
+        except Exception:
+            pass  # doorbell gone: the client died; the slab is retired
+
+    def _respond(self, seq, slot, resp: VerifyResponse, *, held: bool) -> None:
+        msg = resp.message.encode("utf-8")[:0xFFFF]
+        verdicts = bytes(1 if ok else 0 for ok in resp.verdicts)
+        body = (
+            _RESP_HEAD.pack(
+                seq, slot, resp.status, 1 if held else 0,
+                resp.queue_depth, len(msg),
+            )
+            + msg
+            + verdicts
+        )
+        try:
+            self._transport.write(_FRAME_HDR.pack(len(body), MSG_RESP) + body)
+        except Exception:
+            pass  # client hung up mid-request; connection_lost reclaims
+
+    def _retire(self, seq: int, slot: int, lanes: int, gen: int) -> None:
+        ring = self._ring
+        with self._mtx:
+            if self._closed:
+                return
+            self._inflight.discard(seq)
+            self._retired.add(seq)
+            if gen > self._last_gen[slot]:
+                self._last_gen[slot] = gen
+            while self._tail_seq in self._retired:
+                self._retired.discard(self._tail_seq)
+                self._tail_seq += 1
+                # written under _mtx so concurrent retires can't publish
+                # an older tail over a newer one
+                ring.set_tail(self._tail_seq)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def backlog(self) -> int:
+        with self._mtx:
+            return self._backlog
+
+    def close(self) -> None:
+        with self._mtx:
+            if self._closed:
+                return
+            self._closed = True
+            self._backlog = 0
+        # reclaim on client death: drop the mapping and tear the name
+        # out of the filesystem so a dead client's ring can't pin memory
+        _close_quiet(self._seg)
+        _unlink_quiet(self._seg)
+
+
+class _ShmServerProtocol:
+    """Evloop protocol for one doorbell connection (loop thread only)."""
+
+    def __init__(self, endpoint: "ShmEndpoint", transport):
+        self._endpoint = endpoint
+        self._transport = transport
+        self._frames = _FrameBuf()
+        self._session: Optional[_ShmSession] = None
+
+    def data_received(self, data: bytes) -> None:
+        for typ, body in self._frames.feed(data):
+            if self._session is None:
+                if typ != MSG_ATTACH:
+                    raise ValueError("expected ATTACH")
+                self._attach(body)
+            elif typ == MSG_COMMIT:
+                seq, slot, lanes = _COMMIT_BODY.unpack(body)
+                if not self._session.on_commit(seq, slot, lanes):
+                    raise ValueError("bad COMMIT cursor")
+            else:
+                raise ValueError(f"unexpected doorbell frame {typ}")
+
+    def _attach(self, body: bytes) -> None:
+        try:
+            off = 0
+            (tlen,) = struct.unpack_from("<H", body, off)
+            off += 2
+            token = body[off : off + tlen].decode("utf-8")
+            off += tlen
+            (nlen,) = struct.unpack_from("<H", body, off)
+            off += 2
+            name = body[off : off + nlen].decode("utf-8")
+            off += nlen
+            nslabs, slab_bytes = struct.unpack_from("<II", body, off)
+            if not hmac.compare_digest(token, self._endpoint.token):
+                raise ValueError("bad endpoint token")
+            seg = shared_memory.SharedMemory(name=name, create=False)
+            try:
+                ring = SlabRing.attach(seg.buf, nslabs, slab_bytes)
+            except ValueError:
+                _close_quiet(seg)
+                raise
+        except (ValueError, OSError, struct.error) as exc:
+            self._endpoint.note_fallback()
+            msg = str(exc).encode("utf-8")[:512]
+            self._transport.write(_FRAME_HDR.pack(len(msg), MSG_ATTACH_ERR) + msg)
+            self._transport.close()
+            return
+        self._session = _ShmSession(self._endpoint, self._transport, seg, ring)
+        self._endpoint.register(self._session)
+        self._transport.write(_FRAME_HDR.pack(0, MSG_ATTACH_OK))
+
+    def eof_received(self) -> None:
+        pass  # connection_lost follows and owns the teardown
+
+    def connection_lost(self, exc) -> None:
+        session, self._session = self._session, None
+        if session is not None:
+            self._endpoint.unregister(session)
+            session.close()
+
+
+@instrument_attrs
+class ShmEndpoint:
+    """Server-side owner of the doorbell listener, the endpoint advert,
+    and every live ring session. ``serve`` is injected by VerifydServer
+    so slab requests ride the exact admission/brownout/tenant path TCP
+    requests do."""
+
+    def __init__(
+        self,
+        serve: Callable[..., VerifyResponse],
+        *,
+        metrics=None,
+        evloop_metrics: Optional[EvloopMetrics] = None,
+        logger=None,
+        workers: int = 8,
+        on_stat: Optional[Callable[[str, int], None]] = None,
+    ):
+        self.serve = serve
+        self.metrics = metrics
+        self.token = secrets.token_hex(16)
+        self._on_stat = on_stat
+        self._mtx = threading.Lock()
+        self._sessions: Dict[int, _ShmSession] = {}  # guarded-by: _mtx
+        self._port: Optional[int] = None  # guarded-by: _mtx
+        self._lsock: Optional[socket.socket] = None  # guarded-by: _mtx
+        self.socket_path = ""  # guarded-by: none(written once in start)
+        self._ev = EvloopServer(
+            lambda t: _ShmServerProtocol(self, t),
+            self._listener,
+            name="verifyd-shm",
+            workers=workers,
+            metrics=evloop_metrics,
+            logger=logger,
+        )
+
+    def _listener(self) -> Optional[socket.socket]:
+        with self._mtx:
+            return self._lsock
+
+    def start(self, port: int) -> None:
+        path = os.path.join(
+            tempfile.gettempdir(),
+            f"tmtpu-shm-{port}-{os.getpid()}-{self.token[:8]}.sock",
+        )
+        try:
+            os.unlink(path)
+        except OSError:
+            pass  # stale socket from a dead pid; bind() reports real errors
+        lsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        lsock.bind(path)
+        os.chmod(path, 0o600)
+        lsock.listen(64)
+        with self._mtx:
+            self._lsock = lsock
+            self._port = port
+        self.socket_path = path
+        self._ev.start()
+        advertise(port, path, self.token)
+
+    def stop(self) -> None:
+        with self._mtx:
+            port = self._port
+            lsock, self._lsock = self._lsock, None
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        if port is not None:
+            retract(port, self.token)
+        self._ev.stop()
+        if lsock is not None:
+            try:
+                lsock.close()
+            except OSError:
+                pass  # already closed by the evloop teardown
+        if self.socket_path:
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass  # socket path already removed: best-effort
+        for s in sessions:
+            s.close()
+        self.occupancy_changed()
+
+    # -- session registry / stats ------------------------------------------
+
+    def register(self, session: _ShmSession) -> None:
+        with self._mtx:
+            self._sessions[id(session)] = session
+
+    def unregister(self, session: _ShmSession) -> None:
+        with self._mtx:
+            self._sessions.pop(id(session), None)
+        self.occupancy_changed()
+
+    def session_count(self) -> int:
+        with self._mtx:
+            return len(self._sessions)
+
+    def backlog_lanes(self) -> int:
+        """Lanes committed to rings but not yet handed to the serve
+        path — the shm contribution to the admission pressure signal."""
+        with self._mtx:
+            sessions = list(self._sessions.values())
+        return sum(s.backlog() for s in sessions)
+
+    def occupancy_changed(self) -> None:
+        m = self.metrics
+        if m is not None:
+            m.shm_ring_occupancy.set(self.backlog_lanes())
+
+    def note_lanes(self, n: int) -> None:
+        m = self.metrics
+        if m is not None:
+            m.shm_lanes.inc(n)
+        if self._on_stat is not None:
+            self._on_stat("shm_lanes", n)
+
+    def note_torn(self) -> None:
+        m = self.metrics
+        if m is not None:
+            m.shm_torn_slabs.inc()
+        if self._on_stat is not None:
+            self._on_stat("shm_torn_slabs", 1)
+
+    def note_fallback(self) -> None:
+        m = self.metrics
+        if m is not None:
+            m.shm_fallbacks.inc()
+        if self._on_stat is not None:
+            self._on_stat("shm_fallbacks", 1)
+
+
+# --- client side ----------------------------------------------------------
+
+
+@instrument_attrs
+class ShmClientTransport:
+    """Client half of one ring: creates the segment, attaches over the
+    doorbell socket, and turns ``VerifyRequest``s into slab writes. Safe
+    for concurrent callers (the client's pool threads); slot ownership
+    is exclusive between acquisition under ``_mtx`` and the COMMIT
+    frame, so slab fills run lock-free."""
+
+    def __init__(
+        self,
+        socket_path: str,
+        token: str,
+        *,
+        nslabs: int = DEFAULT_NSLABS,
+        slab_bytes: int = DEFAULT_SLAB_BYTES,
+        connect_timeout: float = 2.0,
+    ):
+        size = CTRL_BYTES + nslabs * slab_bytes
+        seg = shared_memory.SharedMemory(create=True, size=size)
+        ring = SlabRing.create(seg.buf, nslabs, slab_bytes)
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(connect_timeout)
+        try:
+            sock.connect(socket_path)
+            name = seg.name.encode("utf-8")
+            tok = token.encode("utf-8")
+            body = (
+                struct.pack("<H", len(tok)) + tok
+                + struct.pack("<H", len(name)) + name
+                + struct.pack("<II", nslabs, slab_bytes)
+            )
+            _send_frame(sock, MSG_ATTACH, body)
+            length, typ = _FRAME_HDR.unpack(_recv_exact(sock, _FRAME_HDR.size))
+            reply = _recv_exact(sock, length) if length else b""
+            if typ != MSG_ATTACH_OK:
+                raise ShmAttachError(
+                    f"attach rejected: {reply.decode('utf-8', 'replace')}"
+                )
+        except (OSError, ShmError) as exc:
+            try:
+                sock.close()
+            except OSError:
+                pass  # half-open attach socket; the attach error wins
+            _close_quiet(seg)
+            _unlink_quiet(seg)
+            if isinstance(exc, ShmError):
+                raise
+            raise ShmAttachError(f"attach failed: {exc}") from exc
+        sock.settimeout(None)
+        self._seg = seg
+        self._ring = ring
+        self._sock = sock
+        self._send_mtx = threading.Lock()
+        self._mtx = threading.Lock()
+        self._cv = threading.Condition(self._mtx)
+        self._head = 0  # guarded-by: _mtx
+        self._slot_gen = [0] * nslabs  # guarded-by: _mtx
+        self._results: Dict[int, VerifyResponse] = {}  # guarded-by: _mtx
+        self._waiting: Set[int] = set()  # guarded-by: _mtx
+        self._dead = False  # guarded-by: _mtx
+        self._closed = False  # guarded-by: _mtx
+        self._reader = threading.Thread(
+            target=self._read_loop, name="verifyd-shm-reader", daemon=True
+        )
+        self._reader.start()
+
+    @property
+    def alive(self) -> bool:
+        with self._mtx:
+            return not (self._dead or self._closed)
+
+    # -- request path -------------------------------------------------------
+
+    def call(self, req: VerifyRequest, timeout: float) -> VerifyResponse:
+        """Slab-ring unary call. Raises ShmBusy when the ring can't take
+        the request promptly (caller rides TCP for this one) and ShmError
+        when the session is gone (caller renegotiates)."""
+        if len(req) > SHM_MAX_LANES:
+            raise ShmBusy(f"request exceeds shm lane cap: {len(req)}")
+        if slab_bytes_needed(req.msgs) > self._ring.slab_bytes:
+            raise ShmBusy("request exceeds slab capacity")
+        deadline = time.monotonic() + timeout
+        seq, slot, gen = self._acquire(deadline)
+        try:
+            self._fill(slot, gen, req)
+        except Exception as exc:
+            # the slot is burnt (gen consumed, never committed); the
+            # session can't safely reuse it, so tear the transport down
+            self._fail(ShmError("slab fill failed"))
+            raise ShmError(f"slab fill failed: {exc}") from exc
+        self._send_commit(seq, slot, len(req))
+        return self._wait(seq, deadline)
+
+    def _acquire(self, deadline: float) -> Tuple[int, int, int]:
+        ring = self._ring
+        with self._cv:
+            while True:
+                if self._dead or self._closed:
+                    raise ShmError("shm session closed")
+                if self._head - ring.tail() < ring.nslabs:
+                    break
+                # a full ring means the server is the bottleneck; give
+                # it one short beat, then push this request onto TCP so
+                # admission control sees the overload
+                left = min(deadline, time.monotonic() + 0.05) - time.monotonic()
+                if left <= 0 or not self._cv.wait(timeout=left):
+                    if self._head - ring.tail() < ring.nslabs:
+                        continue
+                    raise ShmBusy("slab ring full")
+            seq = self._head
+            self._head = seq + 1
+            ring.set_head(self._head)
+            slot = seq % ring.nslabs
+            gen = self._slot_gen[slot] + 2
+            self._slot_gen[slot] = gen
+            self._waiting.add(seq)
+        return seq, slot, gen
+
+    def _fill(self, slot: int, gen: int, req: VerifyRequest) -> None:
+        ring = self._ring
+        buf = ring.buf
+        base = ring.slab_base(slot)
+        stamp_begin(buf, base, gen)
+        pack_lanes(buf, base, req.pks, req.msgs, req.sigs)
+        pack_header(
+            buf,
+            base,
+            gen=gen,
+            kind=req.kind,
+            klass=req.klass,
+            deadline_ms=req.deadline_ms,
+            algo=req.algo,
+            lanes=len(req),
+            tenant=req.tenant,
+        )
+
+    def _send_commit(self, seq: int, slot: int, lanes: int) -> None:
+        frame = _FRAME_HDR.pack(_COMMIT_BODY.size, MSG_COMMIT) + _COMMIT_BODY.pack(
+            seq, slot, lanes
+        )
+        try:
+            with self._send_mtx:
+                self._sock.sendall(frame)
+        except OSError as exc:
+            self._fail(ShmError(f"doorbell send failed: {exc}"))
+            raise ShmError(f"doorbell send failed: {exc}") from exc
+
+    def _wait(self, seq: int, deadline: float) -> VerifyResponse:
+        with self._cv:
+            while seq not in self._results:
+                if self._dead:
+                    self._waiting.discard(seq)
+                    raise ShmError("shm session died awaiting response")
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(timeout=left):
+                    if seq in self._results:
+                        break
+                    self._waiting.discard(seq)
+                    raise ShmError("timed out awaiting shm response")
+            self._waiting.discard(seq)
+            return self._results.pop(seq)
+
+    # -- reader thread -------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        sock = self._sock
+        try:
+            while True:
+                length, typ = _FRAME_HDR.unpack(
+                    _recv_exact(sock, _FRAME_HDR.size)
+                )
+                body = _recv_exact(sock, length) if length else b""
+                if typ == MSG_RESP:
+                    seq, _slot, status, _held, depth, mlen = _RESP_HEAD.unpack_from(
+                        body, 0
+                    )
+                    off = _RESP_HEAD.size
+                    message = body[off : off + mlen].decode("utf-8", "replace")
+                    verdicts = [b == 1 for b in body[off + mlen :]]
+                    resp = VerifyResponse(
+                        status=status,
+                        verdicts=verdicts,
+                        message=message,
+                        queue_depth=depth,
+                    )
+                    with self._cv:
+                        # drop responses nobody awaits any more (the
+                        # waiter timed out) so _results can't grow
+                        if seq in self._waiting:
+                            self._results[seq] = resp
+                        self._cv.notify_all()
+                elif typ == MSG_FREE:
+                    with self._cv:
+                        self._cv.notify_all()  # tail advanced; ring has room
+                else:
+                    raise ShmError(f"unexpected doorbell frame {typ}")
+        except (OSError, ShmError, struct.error) as exc:
+            self._fail(ShmError(f"doorbell lost: {exc}"))
+
+    def _fail(self, exc: ShmError) -> None:
+        with self._cv:
+            if self._dead:
+                return
+            self._dead = True
+            self._cv.notify_all()
+        try:
+            # shutdown before close: a reader parked in recv pins the
+            # open file description, so close() alone would neither wake
+            # it nor deliver EOF to the server's doorbell
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # already disconnected; close still reclaims the fd
+        try:
+            self._sock.close()
+        except OSError:
+            pass  # reader and closer race the close: either's is fine
+
+    def close(self) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._dead = True
+            self._cv.notify_all()
+        try:
+            # see _fail: wake the parked reader and push EOF at the
+            # server, or the description outlives this close
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # already disconnected; close still reclaims the fd
+        try:
+            self._sock.close()
+        except OSError:
+            pass  # _fail may have closed it first; either's is fine
+        self._reader.join(timeout=2.0)
+        _close_quiet(self._seg)
+        _unlink_quiet(self._seg)
+
+
+def connect(port: int, **kwargs) -> ShmClientTransport:
+    """Negotiate a slab-ring transport against the server advertising
+    on ``port``; raises ShmAttachError when there is no live endpoint."""
+    ep = read_endpoint(port)
+    if ep is None:
+        raise ShmAttachError(f"no shm endpoint advertised for port {port}")
+    return ShmClientTransport(ep["socket"], ep["token"], **kwargs)

@@ -654,6 +654,111 @@ class TestWireCompat:
         assert self.run(src) == []
 
 
+SLAB_DIRTY = """
+    SLAB_OFF_GEN = 0
+    SLAB_OFF_KLASS = 8
+    SLAB_OFF_LANES = 20
+    SLAB_OFF_GEN2 = 92
+
+    def pack_header(buf, base, gen, klass, lanes):
+        struct.pack_into("<I", buf, base + SLAB_OFF_KLASS, klass + 1)
+        struct.pack_into("<I", buf, base + SLAB_OFF_LANES, lanes)
+        struct.pack_into("<I", buf, base + SLAB_OFF_GEN2, gen)
+        struct.pack_into("<I", buf, base + SLAB_OFF_GEN, gen)
+
+    def unpack_header(buf, base):
+        (gen,) = struct.unpack_from("<I", buf, base + SLAB_OFF_GEN)
+        (raw_klass,) = struct.unpack_from("<I", buf, base + SLAB_OFF_KLASS)
+        (gen2,) = struct.unpack_from("<I", buf, base + SLAB_OFF_GEN2)
+        return gen, raw_klass - 1, gen2
+"""
+
+SLAB_CLEAN = """
+    SLAB_OFF_GEN = 0
+    SLAB_OFF_KLASS = 8
+    SLAB_OFF_LANES = 20
+    SLAB_OFF_GEN2 = 92
+
+    def pack_header(buf, base, gen, klass, lanes):
+        struct.pack_into("<I", buf, base + SLAB_OFF_KLASS, klass + 1)
+        struct.pack_into("<I", buf, base + SLAB_OFF_LANES, lanes)
+        struct.pack_into("<I", buf, base + SLAB_OFF_GEN2, gen)
+        struct.pack_into("<I", buf, base + SLAB_OFF_GEN, gen)
+
+    def unpack_header(buf, base):
+        (gen,) = struct.unpack_from("<I", buf, base + SLAB_OFF_GEN)
+        (raw_klass,) = struct.unpack_from("<I", buf, base + SLAB_OFF_KLASS)
+        (lanes,) = struct.unpack_from("<I", buf, base + SLAB_OFF_LANES)
+        (gen2,) = struct.unpack_from("<I", buf, base + SLAB_OFF_GEN2)
+        return gen, raw_klass - 1, lanes, gen2
+"""
+
+
+class TestSlabHeaderSymmetry:
+    def run(self, src):
+        return run_on(
+            WireCompatChecker(), {"tendermint_tpu/verifyd/shm.py": src}
+        )
+
+    def test_field_unpacked_but_never_packed_flagged(self):
+        found = self.run(SLAB_DIRTY)
+        assert codes(found) == ["TPW005"]
+        assert "SLAB_OFF_LANES" in found[0].message
+        assert "unpack_header" in found[0].message
+
+    def test_symmetric_codec_passes(self):
+        assert self.run(SLAB_CLEAN) == []
+
+    def test_missing_unpack_header_flagged(self):
+        src = """
+            SLAB_OFF_GEN = 0
+
+            def pack_header(buf, base, gen):
+                struct.pack_into("<I", buf, base + SLAB_OFF_GEN, gen)
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPW005"]
+        assert "unpack_header" in found[0].message
+
+    def test_undefined_offset_reference_flagged(self):
+        src = """
+            SLAB_OFF_GEN = 0
+
+            def pack_header(buf, base, gen):
+                struct.pack_into("<I", buf, base + SLAB_OFF_GEN, gen)
+                struct.pack_into("<I", buf, base + SLAB_OFF_GENN, gen)
+
+            def unpack_header(buf, base):
+                return struct.unpack_from("<I", buf, base + SLAB_OFF_GEN)
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPW005"]
+        assert "SLAB_OFF_GENN" in found[0].message
+
+    def test_protocol_module_without_slab_codec_not_flagged(self):
+        # the TCP codec module defines no SLAB_OFF_ layout: TPW005 is
+        # inert there rather than demanding slab functions everywhere
+        found = run_on(
+            WireCompatChecker(),
+            {"tendermint_tpu/verifyd/protocol.py": "KIND_RAW = 1\n"},
+        )
+        assert found == []
+
+    def test_real_shm_module_is_clean(self):
+        import pathlib
+
+        src = (
+            pathlib.Path(__file__).resolve().parents[1]
+            / "tendermint_tpu"
+            / "verifyd"
+            / "shm.py"
+        ).read_text()
+        found = run_on(
+            WireCompatChecker(), {"tendermint_tpu/verifyd/shm.py": src}
+        )
+        assert [f for f in found if f.code == "TPW005"] == []
+
+
 # --- hygiene -----------------------------------------------------------------
 
 

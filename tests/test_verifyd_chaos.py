@@ -15,6 +15,7 @@ degradation contract:
   in-flight kernel (trace-span containment proves it).
 """
 
+import os
 import socket
 import threading
 import time
@@ -26,7 +27,7 @@ from tendermint_tpu.crypto.keys import Ed25519PrivKey
 from tendermint_tpu.libs import tracing
 from tendermint_tpu.libs.grpc import PREFACE
 from tendermint_tpu.ops.fault_injection import DeviceFault
-from tendermint_tpu.verifyd import protocol
+from tendermint_tpu.verifyd import protocol, shm
 from tendermint_tpu.verifyd.client import (
     VerifydClient,
     VerifydRejectedError,
@@ -526,7 +527,13 @@ def test_tenant_flood_victim_p99_and_explicit_sheds():
                 t.join(timeout=10)
         victim.close()
 
-        floor = 0.05
+        from tendermint_tpu.libs import sanitizer
+
+        # under tpusan every lock acquire pays vector-clock bookkeeping,
+        # so the SLO measures the instrumentation, not the tier: keep
+        # the assertion but widen the floor (same rationale as the
+        # sanitizer-gated shed threshold below)
+        floor = 0.15 if sanitizer.hb_enabled() else 0.05
         assert loaded_p99 <= 3 * max(unloaded_p99, floor), (
             f"victim p99 {loaded_p99 * 1e3:.1f}ms vs unloaded "
             f"{unloaded_p99 * 1e3:.1f}ms"
@@ -545,6 +552,249 @@ def test_tenant_flood_victim_p99_and_explicit_sheds():
         assert stats["flood"]["sheds"] == sheds
         assert stats.get("victim", {}).get("sheds", 0) == 0
     finally:
+        srv.stop()
+
+
+# --- zero-copy ingress chaos (slab rings) ------------------------------------
+
+
+def _noop_verify(pks, msgs, sigs):
+    return [True] * len(pks)
+
+
+def _junk_request(n, seed=0, **kw):
+    return protocol.VerifyRequest(
+        pks=[bytes([seed % 251 + 1]) * 32] * n,
+        msgs=[b"ring-%d-%d" % (seed, i) for i in range(n)],
+        sigs=[b"\x09" * 64] * n,
+        **kw,
+    )
+
+
+def _shm_server(**kw):
+    kw.setdefault("verify_fn", _noop_verify)
+    kw.setdefault("max_batch", 64)
+    kw.setdefault("max_delay", 0.001)
+    kw.setdefault("shm", "on")
+    srv = VerifydServer(**kw)
+    srv.start()
+    return srv
+
+
+def test_torn_slab_client_died_mid_write_explicit_invalid_and_reclaim():
+    """A writer killed between stamp_begin and publication leaves an
+    odd generation in the slab: the server answers STATUS_INVALID with
+    the torn diagnosis (never a silent drop), counts it, retires the
+    slot, and the very next request reuses the ring."""
+    srv = _shm_server()
+    try:
+        t = shm.connect(srv.address[1])
+        try:
+            seq, slot, gen = t._acquire(time.monotonic() + 5)
+            base = t._ring.slab_base(slot)
+            shm.stamp_begin(t._ring.buf, base, gen)
+            # ...the writer "dies" here: header never published...
+            t._send_commit(seq, slot, 1)
+            resp = t._wait(seq, time.monotonic() + 10)
+            assert resp.status == protocol.STATUS_INVALID
+            assert "torn" in resp.message
+            assert srv.stats()["shm_torn_slabs"] == 1
+            # the slot was retired, not leaked: a full ring of
+            # follow-up calls cycles through it cleanly
+            for i in range(shm.DEFAULT_NSLABS + 1):
+                resp = t.call(_junk_request(2, seed=i), timeout=10.0)
+                assert resp.status == protocol.STATUS_OK
+        finally:
+            t.close()
+        assert srv.stats()["shm_torn_slabs"] == 1  # exactly the one
+    finally:
+        srv.stop()
+
+
+def test_stale_generation_replay_is_torn():
+    """Replaying a slot without re-filling it (cursor corruption, a
+    duplicated doorbell frame) trips the strictly-newer generation
+    check — the seqlock's defense against reading a retired slab."""
+    srv = _shm_server()
+    try:
+        t = shm.connect(srv.address[1])
+        try:
+            # one full ring lap retires generation 2 in every slot
+            for i in range(t._ring.nslabs):
+                resp = t.call(_junk_request(1, seed=i), timeout=10.0)
+                assert resp.status == protocol.STATUS_OK
+            # forge the next commit (slot 0 again) WITHOUT re-filling:
+            # the slab still carries the retired generation 2
+            with t._mtx:
+                seq = t._head
+                t._head = seq + 1
+                t._ring.set_head(t._head)
+                t._waiting.add(seq)
+            assert seq % t._ring.nslabs == 0
+            t._send_commit(seq, 0, 1)
+            resp = t._wait(seq, time.monotonic() + 10)
+            assert resp.status == protocol.STATUS_INVALID
+            assert "stale" in resp.message
+            assert srv.stats()["shm_torn_slabs"] == 1
+        finally:
+            t.close()
+    finally:
+        srv.stop()
+
+
+def test_client_killed_mid_write_server_reclaims_segment():
+    """SIGKILL equivalent: the doorbell socket dies with a slab write
+    in progress. The server must drop the session AND unlink the
+    segment so a dead client's ring cannot pin memory."""
+    srv = _shm_server()
+    try:
+        t = shm.connect(srv.address[1])
+        seg_name = t._seg.name
+        seg_path = os.path.join("/dev/shm", seg_name.lstrip("/"))
+        has_dev_shm = os.path.exists(seg_path)
+        # a write in progress when the client dies
+        seq, slot, gen = t._acquire(time.monotonic() + 5)
+        shm.stamp_begin(t._ring.buf, t._ring.slab_base(slot), gen)
+        # the kill: no farewell frame, no segment cleanup. shutdown()
+        # models kernel-side fd teardown on process death — a bare
+        # close() here would be weaker than death, because the reader
+        # thread parked in recv pins the description and no EOF would
+        # ever reach the server
+        t._sock.shutdown(socket.SHUT_RDWR)
+        t._sock.close()
+        deadline = time.monotonic() + 5
+        while (
+            srv.stats()["shm_sessions"] > 0 and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert srv.stats()["shm_sessions"] == 0
+        if has_dev_shm:
+            deadline = time.monotonic() + 5
+            while os.path.exists(seg_path) and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not os.path.exists(seg_path), "segment leaked"
+        t.close()  # the client half of the mapping (idempotent)
+    finally:
+        srv.stop()
+
+
+def test_server_restart_with_live_ring_client_falls_back_then_renegotiates():
+    """The daemon restarts while a slab-ring session is live: the
+    client's next call rides TCP explicitly (no hang, no loss), and
+    after the retry cooldown it renegotiates a fresh ring against the
+    new instance."""
+    srv = _shm_server()
+    h, p = srv.address
+    c = VerifydClient(
+        f"{h}:{p}", shm="auto", fallback=False, retries=10, backoff=0.05
+    )
+    srv2 = None
+    try:
+        assert c.verify(
+            *_lanes_of(_junk_request(3, seed=1))
+        ) == [True] * 3
+        assert c.transport == "shm"
+        srv.stop()
+        srv2 = _shm_server(host=h, port=p)
+        # the dead ring is detected, the call resolves over TCP
+        assert c.verify(
+            *_lanes_of(_junk_request(3, seed=2))
+        ) == [True] * 3
+        time.sleep(1.1)  # shm renegotiation cooldown
+        assert c.verify(
+            *_lanes_of(_junk_request(3, seed=3))
+        ) == [True] * 3
+        assert c.transport == "shm"  # fresh ring against the new server
+        deadline = time.monotonic() + 5
+        while (
+            srv2.stats()["shm_sessions"] < 1 and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert srv2.stats()["shm_sessions"] == 1
+        c.close()
+    finally:
+        c.close()
+        if srv2 is not None:
+            srv2.stop()
+        else:
+            srv.stop()
+
+
+def _lanes_of(req):
+    return req.pks, req.msgs, req.sigs
+
+
+def test_slow_consumer_shm_backlog_feeds_admission_and_brownout():
+    """Slab lanes committed but not yet drained MUST count as pressure:
+    with the drain workers wedged, the scheduler is provably idle yet
+    TCP rpc traffic sheds on queue depth and the brownout ladder
+    escalates — the shm-only overload the ISSUE's acceptance demands.
+    Once the consumer resumes, every wedged call resolves explicitly."""
+    gate = threading.Event()
+    srv = _shm_server(
+        admission_cap=64,
+        brownout=BrownoutController(escalate_after=0.05, cooldown_fn=None),
+    )
+    shm._TEST_DRAIN_GATE = gate.wait
+    statuses = []
+    st_mtx = threading.Lock()
+    try:
+        h, p = srv.address
+        t = shm.connect(p)
+
+        def submit(i):
+            # consensus class: never shed, so post-release statuses stay
+            # explicit regardless of the ladder's level at drain time
+            resp = t.call(
+                _junk_request(
+                    100, seed=i, klass=protocol.CLASS_CONSENSUS
+                ),
+                timeout=30.0,
+            )
+            with st_mtx:
+                statuses.append(resp.status)
+
+        writers = [
+            threading.Thread(target=submit, args=(i,)) for i in range(4)
+        ]
+        for th in writers:
+            th.start()
+        deadline = time.monotonic() + 5
+        while srv.shm_backlog() < 400 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert srv.shm_backlog() >= 400  # lanes visible as pressure...
+        assert srv.scheduler.load_depth() == 0  # ...with an IDLE scheduler
+        # rpc probes over the escalation window: depth(=shm backlog)
+        # alone must shed them and walk the ladder up
+        probe = _client(f"{h}:{p}", shm="off")
+        sheds = 0
+        t_end = time.monotonic() + 0.3
+        while time.monotonic() < t_end:
+            try:
+                probe.verify(
+                    *_lanes_of(_junk_request(2, seed=77)),
+                    klass=protocol.CLASS_RPC,
+                )
+            except VerifydRejectedError as exc:
+                assert exc.status == protocol.STATUS_RESOURCE_EXHAUSTED
+                sheds += 1
+            time.sleep(0.01)
+        probe.close()
+        assert sheds >= 1, "shm-only backlog did not shed rpc"
+        assert srv.brownout.level > LEVEL_NORMAL
+        gate.set()
+        for th in writers:
+            th.join(timeout=30)
+        t.close()
+        assert len(statuses) == 4  # zero silent drops
+        assert all(s == protocol.STATUS_OK for s in statuses), statuses
+        deadline = time.monotonic() + 5
+        while srv.shm_backlog() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.shm_backlog() == 0
+    finally:
+        shm._TEST_DRAIN_GATE = None
+        gate.set()
         srv.stop()
 
 

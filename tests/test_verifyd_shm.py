@@ -1,0 +1,494 @@
+"""Zero-copy shm ingress (verifyd/shm.py): slab-header codec symmetry,
+the ring state machine under concurrency (the tpusan hb + seeded-explore
+target for the zero-copy PR), and transparent transport negotiation.
+
+The chaos half of the contract — torn slabs, client death mid-write,
+server restart with live rings, slow-consumer backpressure into
+admission — lives in tests/test_verifyd_chaos.py.
+"""
+
+import os
+import struct
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.verifyd import protocol, shm
+from tendermint_tpu.verifyd.client import VerifydClient
+from tendermint_tpu.verifyd.server import VerifydServer
+
+
+def noop_verify(pks, msgs, sigs):
+    return [True] * len(pks)
+
+
+def junk_lanes(n, seed=0):
+    """Synthetic lanes for the noop verifier: distinct msgs keep the
+    scheduler's coalescing keys distinct."""
+    return (
+        [bytes([seed % 251 + 1]) * 32] * n,
+        [b"shm-%d-%d" % (seed, i) for i in range(n)],
+        [b"\x07" * 64] * n,
+    )
+
+
+def make_request(n, seed=0, **kw):
+    pks, msgs, sigs = junk_lanes(n, seed)
+    return protocol.VerifyRequest(pks=pks, msgs=msgs, sigs=sigs, **kw)
+
+
+def start_server(**kw):
+    kw.setdefault("verify_fn", noop_verify)
+    kw.setdefault("max_batch", 64)
+    kw.setdefault("max_delay", 0.001)
+    kw.setdefault("shm", "on")
+    srv = VerifydServer(**kw)
+    srv.start()
+    return srv
+
+
+# --- slab header codec -------------------------------------------------------
+
+
+class TestSlabHeader:
+    def _buf(self):
+        return bytearray(shm.SLAB_HEADER_BYTES + 64)
+
+    def test_round_trip_all_fields(self):
+        buf = self._buf()
+        shm.pack_header(
+            buf, 0, gen=4, kind=protocol.KIND_COMMIT,
+            klass=protocol.CLASS_LIGHT, deadline_ms=250,
+            algo=protocol.ALGO_SR25519, lanes=17, tenant="chain-a",
+        )
+        hdr = shm.unpack_header(buf, 0)
+        assert hdr == {
+            "gen": 4, "kind": protocol.KIND_COMMIT,
+            "klass": protocol.CLASS_LIGHT, "deadline_ms": 250,
+            "algo": protocol.ALGO_SR25519, "lanes": 17, "tenant": "chain-a",
+        }
+
+    def test_consensus_class_zero_survives(self):
+        """CLASS_CONSENSUS is 0; it rides the slab +1 so a zeroed word
+        cannot masquerade as it — the TCP codec's zero-omission rule."""
+        buf = self._buf()
+        shm.pack_header(
+            buf, 0, gen=2, kind=protocol.KIND_RAW,
+            klass=protocol.CLASS_CONSENSUS, deadline_ms=0,
+            algo=protocol.ALGO_ED25519, lanes=1,
+        )
+        (stored,) = struct.unpack_from("<I", buf, shm.SLAB_OFF_KLASS)
+        assert stored == 1  # on-slab encoding is +1
+        assert shm.unpack_header(buf, 0)["klass"] == protocol.CLASS_CONSENSUS
+
+    def test_zeroed_klass_word_decodes_to_rpc(self):
+        """0 = absent -> CLASS_RPC, mirroring the omitted proto3 field."""
+        buf = self._buf()
+        shm.pack_header(
+            buf, 0, gen=2, kind=protocol.KIND_RAW,
+            klass=protocol.CLASS_BLOCKSYNC, deadline_ms=0,
+            algo=protocol.ALGO_ED25519, lanes=1,
+        )
+        struct.pack_into("<I", buf, shm.SLAB_OFF_KLASS, 0)
+        assert shm.unpack_header(buf, 0)["klass"] == protocol.CLASS_RPC
+
+    def test_default_tenant_omitted(self):
+        buf = self._buf()
+        shm.pack_header(
+            buf, 0, gen=2, kind=protocol.KIND_RAW,
+            klass=protocol.CLASS_RPC, deadline_ms=0,
+            algo=protocol.ALGO_ED25519, lanes=1,
+            tenant=protocol.DEFAULT_TENANT,
+        )
+        (tlen,) = struct.unpack_from("<I", buf, shm.SLAB_OFF_TENANT_LEN)
+        assert tlen == 0  # stored as ABSENT, like the omitted field 6
+        assert (
+            shm.unpack_header(buf, 0)["tenant"] == protocol.DEFAULT_TENANT
+        )
+
+    def test_odd_generation_is_torn(self):
+        buf = self._buf()
+        shm.pack_header(
+            buf, 0, gen=2, kind=protocol.KIND_RAW,
+            klass=protocol.CLASS_RPC, deadline_ms=0,
+            algo=protocol.ALGO_ED25519, lanes=1,
+        )
+        shm.stamp_begin(buf, 0, 4)  # writer died mid-fill of gen 4
+        with pytest.raises(ValueError, match="torn"):
+            shm.unpack_header(buf, 0)
+
+    def test_generation_stamp_mismatch_is_torn(self):
+        buf = self._buf()
+        shm.pack_header(
+            buf, 0, gen=2, kind=protocol.KIND_RAW,
+            klass=protocol.CLASS_RPC, deadline_ms=0,
+            algo=protocol.ALGO_ED25519, lanes=1,
+        )
+        struct.pack_into("<I", buf, shm.SLAB_OFF_GEN2, 6)
+        with pytest.raises(ValueError, match="torn"):
+            shm.unpack_header(buf, 0)
+
+    def test_field_validation(self):
+        for field_off, bad in (
+            (shm.SLAB_OFF_KIND, 99),
+            (shm.SLAB_OFF_ALGO, 99),
+            (shm.SLAB_OFF_LANES, shm.SHM_MAX_LANES + 1),
+            (shm.SLAB_OFF_TENANT_LEN, protocol.MAX_TENANT_LEN + 1),
+        ):
+            buf = self._buf()
+            shm.pack_header(
+                buf, 0, gen=2, kind=protocol.KIND_RAW,
+                klass=protocol.CLASS_RPC, deadline_ms=0,
+                algo=protocol.ALGO_ED25519, lanes=1,
+            )
+            struct.pack_into("<I", buf, field_off, bad)
+            struct.pack_into("<I", buf, shm.SLAB_OFF_GEN2, 2)
+            struct.pack_into("<I", buf, shm.SLAB_OFF_GEN, 2)
+            with pytest.raises(ValueError):
+                shm.unpack_header(buf, 0)
+
+    def test_lane_payload_round_trip_zero_copy(self):
+        pks, msgs, sigs = junk_lanes(5, seed=3)
+        buf = bytearray(shm.slab_bytes_needed(msgs) + 16)
+        shm.pack_lanes(buf, 0, pks, msgs, sigs)
+        got_pks, got_msgs, got_sigs = shm.unpack_lanes(
+            memoryview(buf), 0, 5, len(buf)
+        )
+        assert got_pks == pks and got_sigs == sigs
+        assert all(type(m) is memoryview for m in got_msgs)
+        assert [bytes(m) for m in got_msgs] == msgs
+
+    def test_lane_table_walking_out_of_slab_rejected(self):
+        pks, msgs, sigs = junk_lanes(2)
+        buf = bytearray(shm.slab_bytes_needed(msgs) + 16)
+        shm.pack_lanes(buf, 0, pks, msgs, sigs)
+        # corrupt one msg_len so the payload claims to exceed the slab
+        struct.pack_into("<I", buf, shm.SLAB_HEADER_BYTES, 1 << 20)
+        with pytest.raises(ValueError):
+            shm.unpack_lanes(memoryview(buf), 0, 2, len(buf))
+
+
+def test_encoded_request_size_matches_encoder():
+    """``codec_bytes_avoided`` must report what the TCP wire would have
+    cost — exactly, over every zero-omission branch of the encoder."""
+    cases = [
+        make_request(3),
+        make_request(1, klass=protocol.CLASS_CONSENSUS),
+        make_request(4, kind=protocol.KIND_COMMIT, deadline_ms=500),
+        make_request(2, algo=protocol.ALGO_SR25519, tenant="chain-b"),
+        protocol.VerifyRequest(
+            pks=[b"\x01" * 32], msgs=[b""], sigs=[b"\x02" * 64]
+        ),
+        make_request(7, klass=protocol.CLASS_BLOCKSYNC, tenant="x" * 64),
+    ]
+    for req in cases:
+        assert protocol.encoded_request_size(req) == len(
+            protocol.encode_request(req)
+        ), req
+
+
+# --- ring state machine (tpusan hb + seeded-explore target) ------------------
+
+
+class TestRingStateMachine:
+    def test_sequential_calls_reuse_slots_past_ring_size(self):
+        srv = start_server()
+        try:
+            t = shm.connect(srv.address[1])
+            try:
+                rounds = shm.DEFAULT_NSLABS * 3 + 1
+                for i in range(rounds):
+                    resp = t.call(make_request(2, seed=i), timeout=10.0)
+                    assert resp.status == protocol.STATUS_OK
+                    assert resp.verdicts == [True, True]
+            finally:
+                t.close()
+            assert srv.stats()["shm_lanes"] == rounds * 2
+            assert srv.stats()["shm_torn_slabs"] == 0
+        finally:
+            srv.stop()
+
+    def test_concurrent_callers_share_one_ring(self):
+        """Pool threads race acquire/fill/commit/wait on one transport;
+        every call resolves with correct verdict counts and no slab is
+        ever read torn. This is the schedule-exploration target."""
+        srv = start_server(max_batch=32)
+        try:
+            t = shm.connect(srv.address[1])
+            errors = []
+            done = [0]
+            mtx = threading.Lock()
+
+            def caller(i):
+                try:
+                    for j in range(6):
+                        n = 1 + (i + j) % 4
+                        # consensus class: exercises the ring, never the
+                        # shed path (serialized explore schedules inflate
+                        # service-time EWMAs enough to shed rpc lanes)
+                        resp = t.call(
+                            make_request(
+                                n,
+                                seed=i * 100 + j,
+                                klass=protocol.CLASS_CONSENSUS,
+                            ),
+                            timeout=30.0,
+                        )
+                        assert resp.status == protocol.STATUS_OK, resp
+                        assert len(resp.verdicts) == n
+                    with mtx:
+                        done[0] += 1
+                except Exception as exc:  # noqa: BLE001 - recorded
+                    with mtx:
+                        errors.append((i, repr(exc)))
+
+            threads = [
+                threading.Thread(target=caller, args=(i,)) for i in range(4)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=30)
+            t.close()
+            assert not errors, errors
+            assert done[0] == 4
+            assert srv.stats()["shm_torn_slabs"] == 0
+        finally:
+            srv.stop()
+
+    def test_ring_full_raises_busy_and_recovers(self):
+        """A wedged consumer fills the ring; the next acquire raises
+        ShmBusy (the caller's cue to ride TCP) instead of blocking, and
+        the ring drains normally once the consumer resumes."""
+        gate = threading.Event()
+        srv = start_server()
+        shm._TEST_DRAIN_GATE = gate.wait
+        try:
+            t = shm.connect(srv.address[1], nslabs=2)
+            results = []
+            res_mtx = threading.Lock()
+
+            def submit(i):
+                resp = t.call(make_request(1, seed=i), timeout=15.0)
+                with res_mtx:
+                    results.append(resp.status)
+
+            inflight = [
+                threading.Thread(target=submit, args=(i,)) for i in range(2)
+            ]
+            for th in inflight:
+                th.start()
+            deadline = time.monotonic() + 5
+            while t._ring.head() < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            with pytest.raises(shm.ShmBusy):
+                t.call(make_request(1, seed=99), timeout=0.3)
+            gate.set()
+            for th in inflight:
+                th.join(timeout=15)
+            assert results == [protocol.STATUS_OK, protocol.STATUS_OK]
+            # ring usable again after the stall
+            resp = t.call(make_request(1, seed=100), timeout=10.0)
+            assert resp.status == protocol.STATUS_OK
+            t.close()
+        finally:
+            shm._TEST_DRAIN_GATE = None
+            gate.set()
+            srv.stop()
+
+    def test_oversized_request_rides_tcp_session_stays_up(self):
+        """> SHM_MAX_LANES exceeds the slab contract: that one request
+        falls back to TCP (split at the codec's MAX_LANES), counted as a
+        fallback, while the shm session keeps serving."""
+        srv = start_server(
+            max_batch=4096, admission_cap=4 * shm.SHM_MAX_LANES,
+            max_pending=4 * shm.SHM_MAX_LANES,
+        )
+        try:
+            h, p = srv.address
+            # long timeout: the wire deadline derives from it, and the
+            # 8200-lane TCP detour is slow under explore serialization
+            c = VerifydClient(f"{h}:{p}", shm="on", fallback=False,
+                              timeout=60.0)
+            big = shm.SHM_MAX_LANES + 8
+            pks, msgs, sigs = junk_lanes(big, seed=5)
+            oks = c.verify(pks, msgs, sigs, klass=protocol.CLASS_CONSENSUS)
+            assert oks == [True] * big
+            stats = c.stats()
+            assert stats["shm_fallbacks"] == 1
+            assert stats["shm_calls"] == 0
+            # the session survived the detour
+            oks = c.verify(
+                *junk_lanes(4, seed=6), klass=protocol.CLASS_CONSENSUS
+            )
+            assert oks == [True] * 4
+            assert c.stats()["shm_calls"] == 1
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_deadline_response_frees_slab_after_entries_resolve(self):
+        """A deadline verdict can outrun lanes that still hold slab
+        memoryviews: the server answers held, the janitor frees the slab
+        once the flush resolves, and the ring stays fully reusable."""
+        release = threading.Event()
+
+        def gated(pks, msgs, sigs):
+            release.wait(10)
+            return [True] * len(pks)
+
+        srv = start_server(verify_fn=gated, max_delay=0.001)
+        try:
+            t = shm.connect(srv.address[1])
+            resp = t.call(
+                make_request(2, seed=1, deadline_ms=80), timeout=10.0
+            )
+            assert resp.status == protocol.STATUS_DEADLINE_EXCEEDED
+            release.set()
+            # every slot cycles through post-janitor reclaim
+            for i in range(shm.DEFAULT_NSLABS + 2):
+                resp = t.call(make_request(1, seed=10 + i), timeout=10.0)
+                assert resp.status == protocol.STATUS_OK
+            t.close()
+        finally:
+            release.set()
+            srv.stop()
+
+
+# --- negotiation -------------------------------------------------------------
+
+
+class TestNegotiation:
+    def test_auto_negotiates_shm_when_colocated(self):
+        srv = start_server()
+        try:
+            h, p = srv.address
+            assert srv.shm_socket_path
+            c = VerifydClient(f"{h}:{p}", shm="auto", fallback=False)
+            assert c.transport == "tcp"  # nothing negotiated yet
+            oks = c.verify(*junk_lanes(3, seed=1))
+            assert oks == [True] * 3
+            assert c.transport == "shm"
+            stats = c.stats()
+            assert stats["shm_calls"] == 1
+            assert stats["shm_lanes"] == 3
+            assert stats["shm_bytes_avoided"] > 0
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_off_mode_restores_pure_tcp(self):
+        srv = start_server()
+        try:
+            h, p = srv.address
+            c = VerifydClient(f"{h}:{p}", shm="off", fallback=False)
+            oks = c.verify(*junk_lanes(3, seed=2))
+            assert oks == [True] * 3
+            stats = c.stats()
+            assert stats["transport"] == "tcp"
+            assert stats["shm_calls"] == 0
+            assert stats["shm_fallbacks"] == 0
+            assert stats["shm_bytes_avoided"] == 0
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_tcp_only_server_is_negotiation_not_fallback_in_auto(self):
+        srv = start_server(shm="off")
+        try:
+            h, p = srv.address
+            assert srv.shm_socket_path == ""
+            c = VerifydClient(f"{h}:{p}", shm="auto", fallback=False)
+            assert c.verify(*junk_lanes(2, seed=3)) == [True, True]
+            assert c.transport == "tcp"
+            assert c.stats()["shm_fallbacks"] == 0  # auto: working as designed
+            c.close()
+            # "on" is a demand: the missing endpoint counts
+            c2 = VerifydClient(f"{h}:{p}", shm="on", fallback=False)
+            assert c2.verify(*junk_lanes(2, seed=4)) == [True, True]
+            assert c2.stats()["shm_fallbacks"] == 1
+            c2.close()
+        finally:
+            srv.stop()
+
+    def test_mode_resolution_order(self, monkeypatch):
+        monkeypatch.delenv(shm.SHM_ENV, raising=False)
+        assert shm.shm_mode() == "auto"
+        monkeypatch.setenv(shm.SHM_ENV, "off")
+        assert shm.shm_mode() == "off"
+        monkeypatch.setenv(shm.SHM_ENV, "bogus")
+        assert shm.shm_mode() == "auto"  # forgiving, like ops/ flags
+        monkeypatch.setenv(shm.SHM_ENV, "off")
+        shm.set_shm_mode("on")  # config file beats environment
+        try:
+            assert shm.shm_mode() == "on"
+        finally:
+            shm.set_shm_mode("")
+        assert shm.shm_mode() == "off"
+        with pytest.raises(ValueError):
+            shm.set_shm_mode("sideways")
+
+    def test_remote_host_never_attaches(self):
+        srv = start_server()
+        try:
+            _, p = srv.address
+            assert not shm.is_local("db3.example.com")
+            assert shm.is_local("127.0.0.1") and shm.is_local("localhost")
+            c = VerifydClient(f"127.0.0.1:{p}", fallback=False)
+            c._shm_local = False  # as a cross-host addr would resolve
+            assert c.verify(*junk_lanes(2, seed=5)) == [True, True]
+            assert c.transport == "tcp"
+            assert c.stats()["shm_calls"] == 0
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_advertise_retract_is_token_scoped(self, tmp_path):
+        port = 59999
+        path = shm.advertise(port, "/tmp/sock-a", "token-a")
+        try:
+            assert shm.read_endpoint(port)["token"] == "token-a"
+            assert (os.stat(path).st_mode & 0o777) == 0o600
+            # a restarted server replaced the advert; the old instance's
+            # retract must not tear the new advert down
+            shm.advertise(port, "/tmp/sock-b", "token-b")
+            shm.retract(port, "token-a")
+            assert shm.read_endpoint(port)["token"] == "token-b"
+            shm.retract(port, "token-b")
+            assert shm.read_endpoint(port) is None
+        finally:
+            try:
+                os.unlink(shm.endpoint_path(port))
+            except OSError:
+                pass
+
+    def test_attach_with_bad_token_rejected(self):
+        srv = start_server()
+        try:
+            _, p = srv.address
+            ep = shm.read_endpoint(p)
+            with pytest.raises(shm.ShmAttachError, match="token"):
+                shm.ShmClientTransport(ep["socket"], "not-the-token")
+            deadline = time.monotonic() + 5
+            while (
+                srv.stats()["shm_fallbacks"] < 1
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert srv.stats()["shm_fallbacks"] == 1
+        finally:
+            srv.stop()
+
+    def test_server_stats_surface_shm_counters(self):
+        srv = start_server()
+        try:
+            stats = srv.stats()
+            for key in (
+                "shm_lanes", "shm_torn_slabs", "shm_fallbacks",
+                "shm_sessions",
+            ):
+                assert key in stats
+            assert srv.shm_backlog() == 0
+        finally:
+            srv.stop()
