@@ -45,8 +45,12 @@ The doorbell is a per-client AF_UNIX socket riding the existing evloop
 selector-level readiness (the pipe-doorbell pattern — the payload
 itself never touches the socket), and responses/FREE frames ride the
 same pipe back. Negotiation: the server advertises
-``{socket, token}`` in a per-port endpoint file under the system temp
-dir; ``VerifydClient`` attaches when it shares a host with the server
+``{socket, token}`` in a per-port endpoint file under a per-user 0700
+runtime dir (``XDG_RUNTIME_DIR``, else a per-euid temp subdir) whose
+ownership is verified before either side trusts it — a predictable
+advert name in a world-writable dir would let any local user point
+clients at a verdict-forging socket;
+``VerifydClient`` attaches when it shares a host with the server
 and ``TENDERMINT_TPU_SHM`` (or the ``[ops] verify_shm`` config key)
 resolves to ``auto``/``on``. TCP remains the fallback and the
 cross-host path; ``off`` restores it byte-identically.
@@ -66,6 +70,7 @@ import json
 import os
 import secrets
 import socket
+import stat
 import struct
 import tempfile
 import threading
@@ -142,6 +147,11 @@ _COMMIT_BODY = struct.Struct("<QII")  # seq, slot, lanes
 _RESP_HEAD = struct.Struct("<QIBBIH")  # seq, slot, status, held, depth, msg_len
 _FREE_BODY = struct.Struct("<QI")  # seq, slot
 _MAX_FRAME = 1 << 20
+
+# how long a HELD slab may keep its scheduler entries unresolved before
+# the janitor gives up on reclaiming it gracefully and fails the
+# session loud (see _ShmSession._janitor)
+_JANITOR_GRACE_S = 15.0
 
 
 class ShmError(ConnectionError):
@@ -280,6 +290,11 @@ def unpack_lanes(
     the slab (torn write that passed the generation check is still
     bounded here)."""
     table_off = base + SLAB_HEADER_BYTES
+    # bound the table BEFORE unpacking: on the segment's last slab a
+    # garbage lane count would otherwise run struct.unpack_from off the
+    # end of the buffer and raise struct.error instead of ValueError
+    if SLAB_HEADER_BYTES + 4 * lanes > slab_bytes:
+        raise ValueError("lane table exceeds slab")
     msg_lens = struct.unpack_from(f"<{lanes}I", buf, table_off)
     payload = sum(msg_lens) + lanes * _LANE_FIXED
     if SLAB_HEADER_BYTES + 4 * lanes + payload > slab_bytes:
@@ -340,10 +355,40 @@ def is_local(host: str) -> bool:
     return host in _LOCAL_HOSTS or host == socket.gethostname().lower()
 
 
+def _runtime_dir() -> str:
+    """Per-user 0700 directory holding adverts and doorbell sockets.
+
+    Advert names are predictable, so they must not live in the
+    world-writable temp dir: any local user could pre-create the advert
+    for a port and point clients at their own socket, which ACKs every
+    token and returns forged verdicts — a signature-verification bypass
+    for consensus lanes. XDG_RUNTIME_DIR is per-user 0700 by contract;
+    the fallback is a per-euid subdir of the temp dir whose ownership
+    and mode are re-verified on every use (a pre-created symlink or
+    foreign-owned dir fails the lstat checks and disables shm)."""
+    base = os.environ.get("XDG_RUNTIME_DIR", "").strip()
+    if base and os.path.isdir(base):
+        path = os.path.join(base, "tendermint-tpu")
+    else:
+        path = os.path.join(
+            tempfile.gettempdir(), f"tendermint-tpu-{os.geteuid()}"
+        )
+    try:
+        os.mkdir(path, 0o700)
+    except FileExistsError:
+        pass  # already created (by us or an attacker): lstat below judges it
+    st = os.lstat(path)
+    if (
+        not stat.S_ISDIR(st.st_mode)
+        or st.st_uid != os.geteuid()
+        or (st.st_mode & 0o077)
+    ):
+        raise ShmError(f"untrusted shm runtime dir: {path}")
+    return path
+
+
 def endpoint_path(port: int) -> str:
-    return os.path.join(
-        tempfile.gettempdir(), f"tendermint-tpu-verifyd-{port}.shm"
-    )
+    return os.path.join(_runtime_dir(), f"tendermint-tpu-verifyd-{port}.shm")
 
 
 def advertise(port: int, socket_path: str, token: str) -> str:
@@ -364,11 +409,35 @@ def advertise(port: int, socket_path: str, token: str) -> str:
 
 
 def read_endpoint(port: int) -> Optional[dict]:
+    # O_NOFOLLOW + fstat owner/mode checks: even inside the runtime
+    # dir, never follow a symlink or trust a file another uid wrote —
+    # a spoofed advert is a verdict-forgery vector for consensus lanes
     try:
-        with open(endpoint_path(port), "r", encoding="utf-8") as fh:
-            ep = json.load(fh)
+        fd = os.open(
+            endpoint_path(port),
+            os.O_RDONLY | getattr(os, "O_NOFOLLOW", 0),
+        )
+    except OSError:
+        return None
+    try:
+        st = os.fstat(fd)
+        if (
+            not stat.S_ISREG(st.st_mode)
+            or st.st_uid != os.geteuid()
+            or (st.st_mode & 0o077)
+        ):
+            return None
+        chunks = []
+        while True:
+            chunk = os.read(fd, 65536)
+            if not chunk:
+                break
+            chunks.append(chunk)
+        ep = json.loads(b"".join(chunks).decode("utf-8"))
     except (OSError, ValueError):
         return None
+    finally:
+        os.close(fd)
     if not isinstance(ep, dict) or ep.get("v") != SHM_VERSION:
         return None
     if not ep.get("socket") or not ep.get("token"):
@@ -424,6 +493,20 @@ def _close_quiet(seg: shared_memory.SharedMemory) -> None:
         pass
     except OSError:
         pass  # double-close on a torn-down mapping: best-effort
+
+
+def _check_peer(sock: socket.socket) -> None:
+    """Defence in depth behind the 0700 runtime dir: refuse to attach
+    unless the doorbell peer runs as our own uid — a spoofed server
+    could ACK any token and hand back forged verify verdicts."""
+    if not hasattr(socket, "SO_PEERCRED"):
+        return  # non-Linux: the runtime-dir ownership check is the gate
+    creds = sock.getsockopt(
+        socket.SOL_SOCKET, socket.SO_PEERCRED, struct.calcsize("3i")
+    )
+    _pid, uid, _gid = struct.unpack("3i", creds)
+    if uid != os.geteuid():
+        raise ShmAttachError(f"doorbell peer uid {uid} != {os.geteuid()}")
 
 
 def _send_frame(sock: socket.socket, typ: int, body: bytes) -> None:
@@ -601,8 +684,11 @@ class _ShmSession:
                     else f"torn slab: lane count {hdr['lanes']} != {lanes}"
                 )
             pks, msgs, sigs = unpack_lanes(ring.buf, base, lanes, ring.slab_bytes)
-        except ValueError as exc:
+        except (ValueError, struct.error) as exc:
+            # struct.error is belt-and-braces: an escaping exception
+            # would strand seq in _inflight and wedge TAIL forever
             endpoint.note_torn()
+            self._unbook(lanes)
             self._respond(
                 seq,
                 slot,
@@ -626,9 +712,7 @@ class _ShmSession:
         # lanes are now the scheduler's problem; they stop counting as
         # ring backlog the moment the serve path (admission included)
         # sees them, so the pressure signal never double-counts
-        with self._mtx:
-            self._backlog -= lanes
-        endpoint.occupancy_changed()
+        self._unbook(lanes)
         endpoint.note_lanes(lanes)
         entries: List[object] = []
         resp = endpoint.serve(req, t0, tag=self, on_entries=entries.extend)
@@ -645,10 +729,33 @@ class _ShmSession:
         else:
             self._retire(seq, slot, lanes, gen)
 
+    def _unbook(self, lanes: int) -> None:
+        """Drop ``lanes`` from the committed-but-undrained backlog the
+        moment a drain consumes the slab — success and STATUS_INVALID
+        alike, or every bad slab from a live-but-buggy client would
+        permanently leak its lane count into ``backlog_lanes()`` and
+        inflate the brownout pressure signal until the session closes."""
+        with self._mtx:
+            if not self._closed:
+                self._backlog -= lanes
+        self._endpoint.occupancy_changed()
+
     def _janitor(self, seq, slot, lanes, gen, entries) -> None:
+        deadline = time.monotonic() + _JANITOR_GRACE_S
         for e in entries:
-            if not e.done.wait(timeout=15.0):
-                break  # scheduler wedged; reclaim anyway, bounded wait
+            if not e.done.wait(timeout=max(0.0, deadline - time.monotonic())):
+                # Entries still hold memoryviews into this slab, and
+                # under sustained overload a slow flush is legitimate,
+                # not wedged. Retiring now would let the client reuse
+                # the slot and rewrite bytes the flush-assembly has yet
+                # to materialise — silently wrong verify verdicts. Fail
+                # loud instead: leave TAIL frozen (the slot is never
+                # handed back, so the views stay valid and the pending
+                # flush completes on true bytes) and close the doorbell
+                # so the client drops the session and rides TCP.
+                self._endpoint.note_fallback()
+                self._transport.close()
+                return
         self._retire(seq, slot, lanes, gen)
         try:
             self._transport.write(
@@ -811,7 +918,7 @@ class ShmEndpoint:
 
     def start(self, port: int) -> None:
         path = os.path.join(
-            tempfile.gettempdir(),
+            _runtime_dir(),
             f"tmtpu-shm-{port}-{os.getpid()}-{self.token[:8]}.sock",
         )
         try:
@@ -928,6 +1035,7 @@ class ShmClientTransport:
         sock.settimeout(connect_timeout)
         try:
             sock.connect(socket_path)
+            _check_peer(sock)
             name = seg.name.encode("utf-8")
             tok = token.encode("utf-8")
             body = (

@@ -611,6 +611,74 @@ def test_torn_slab_client_died_mid_write_explicit_invalid_and_reclaim():
         srv.stop()
 
 
+def test_invalid_slab_releases_its_backlog_lanes():
+    """A live-but-buggy client's invalid slabs must not leak their lane
+    counts into the pressure signal: each STATUS_INVALID answer releases
+    the lanes its COMMIT booked, so ``shm_backlog()`` returns to zero
+    instead of permanently inflating load_depth and the brownout
+    ladder while the session stays up."""
+    srv = _shm_server()
+    try:
+        t = shm.connect(srv.address[1])
+        try:
+            for i in range(3):
+                seq, slot, gen = t._acquire(time.monotonic() + 5)
+                base = t._ring.slab_base(slot)
+                shm.stamp_begin(t._ring.buf, base, gen)
+                t._send_commit(seq, slot, 5)  # books 5 lanes, slab torn
+                resp = t._wait(seq, time.monotonic() + 10)
+                assert resp.status == protocol.STATUS_INVALID
+            assert srv.stats()["shm_torn_slabs"] == 3
+            # session still up, every booked lane released
+            assert srv.shm_backlog() == 0
+            resp = t.call(_junk_request(2, seed=9), timeout=10.0)
+            assert resp.status == protocol.STATUS_OK
+            assert srv.shm_backlog() == 0
+        finally:
+            t.close()
+    finally:
+        srv.stop()
+
+
+def test_janitor_timeout_fails_loud_and_never_reuses_held_slab(monkeypatch):
+    """Held-slab entries unresolved past the janitor grace mean the
+    scheduler still holds memoryviews into the slab — under sustained
+    overload that is legitimate, not wedged. Handing the slot back
+    would let the client rewrite bytes a pending flush has yet to
+    materialise (silently wrong verdicts); the janitor must instead
+    freeze TAIL and drop the doorbell so the failure is loud and the
+    client falls back to TCP."""
+    monkeypatch.setattr(shm, "_JANITOR_GRACE_S", 0.3)
+    release = threading.Event()
+
+    def gated(pks, msgs, sigs):
+        release.wait(20)
+        return [True] * len(pks)
+
+    srv = _shm_server(verify_fn=gated)
+    try:
+        t = shm.connect(srv.address[1])
+        try:
+            resp = t.call(
+                _junk_request(2, seed=1, deadline_ms=80), timeout=10.0
+            )
+            assert resp.status == protocol.STATUS_DEADLINE_EXCEEDED
+            # grace expires with the flush still in flight: the session
+            # dies loud instead of retiring the slab under the flush
+            deadline = time.monotonic() + 5
+            while t.alive and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not t.alive
+            assert t._ring.tail() == 0  # held slab never handed back
+            assert srv.stats()["shm_fallbacks"] >= 1
+        finally:
+            release.set()
+            t.close()
+    finally:
+        release.set()
+        srv.stop()
+
+
 def test_stale_generation_replay_is_torn():
     """Replaying a slot without re-filling it (cursor corruption, a
     duplicated doorbell frame) trips the strictly-newer generation

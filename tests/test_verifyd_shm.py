@@ -7,6 +7,7 @@ server restart with live rings, slow-consumer backpressure into
 admission — lives in tests/test_verifyd_chaos.py.
 """
 
+import json
 import os
 import struct
 import threading
@@ -167,6 +168,17 @@ class TestSlabHeader:
         struct.pack_into("<I", buf, shm.SLAB_HEADER_BYTES, 1 << 20)
         with pytest.raises(ValueError):
             shm.unpack_lanes(memoryview(buf), 0, 2, len(buf))
+
+    def test_lane_count_overflowing_table_is_valueerror_not_struct_error(self):
+        """On the segment's LAST slab a garbage lane count whose table
+        alone walks off the buffer must raise ValueError (answered
+        STATUS_INVALID by the drain), not let struct.error escape the
+        drain worker and wedge TAIL behind a forever-inflight seq."""
+        slab = 256
+        buf = bytearray(slab)  # exactly one slab: nothing after it
+        lanes = (slab - shm.SLAB_HEADER_BYTES) // 4 + 1
+        with pytest.raises(ValueError, match="lane table"):
+            shm.unpack_lanes(memoryview(buf), 0, lanes, slab)
 
 
 def test_encoded_request_size_matches_encoder():
@@ -460,6 +472,52 @@ class TestNegotiation:
         finally:
             try:
                 os.unlink(shm.endpoint_path(port))
+            except OSError:
+                pass
+
+    def test_advert_lives_in_private_runtime_dir(self, tmp_path, monkeypatch):
+        """The advert name is predictable, so it must live in a 0700
+        per-user dir — never the world-writable temp dir where any local
+        user could plant a verdict-forging endpoint for a known port."""
+        monkeypatch.setenv("XDG_RUNTIME_DIR", str(tmp_path))
+        port = 59901
+        path = shm.advertise(port, "/tmp/sock-x", "tok")
+        try:
+            advert_dir = os.path.dirname(path)
+            assert advert_dir == str(tmp_path / "tendermint-tpu")
+            assert (os.stat(advert_dir).st_mode & 0o077) == 0
+            assert os.stat(advert_dir).st_uid == os.geteuid()
+            assert shm.read_endpoint(port)["token"] == "tok"
+        finally:
+            shm.retract(port, "tok")
+
+    def test_spoofed_advert_rejected(self, tmp_path, monkeypatch):
+        """Owner/mode/symlink checks on the advert itself: a file our
+        euid did not write with 0600 is never trusted, even inside the
+        runtime dir."""
+        monkeypatch.setenv("XDG_RUNTIME_DIR", str(tmp_path))
+        port = 59902
+        path = shm.advertise(port, "/tmp/sock-y", "tok")
+        try:
+            # group/other-accessible advert: not trusted
+            os.chmod(path, 0o644)
+            assert shm.read_endpoint(port) is None
+            os.chmod(path, 0o600)
+            assert shm.read_endpoint(port)["token"] == "tok"
+            # a symlink planted at the advert name is never followed
+            os.unlink(path)
+            real = tmp_path / "evil-endpoint"
+            real.write_text(
+                json.dumps(
+                    {"v": shm.SHM_VERSION, "socket": "/tmp/evil", "token": "x"}
+                )
+            )
+            os.chmod(real, 0o600)
+            os.symlink(real, path)
+            assert shm.read_endpoint(port) is None
+        finally:
+            try:
+                os.unlink(path)
             except OSError:
                 pass
 
