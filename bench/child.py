@@ -50,9 +50,14 @@ def child_main(name: str) -> int:
     else:
         beat("start (no jax)")
 
-    from tendermint_tpu.libs import tracing
+    from tendermint_tpu.libs import flightrec, tracing
 
     tracing.configure()
+    # Post-mortem ring: a child that dies on an unhandled exception or
+    # SIGTERM dumps its last seconds into the run's shared dump dir
+    # (DIR_ENV inherited from the parent); the runner references every
+    # dump from the partial JSON. SIGKILL leaves the parent's dump only.
+    flightrec.install()
     with tracing.tracer.span("bench_section_body", section=name):
         fragment = section.fn(beat)
 

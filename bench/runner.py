@@ -470,13 +470,46 @@ def mark_skipped(doc: dict, partial_path: Optional[str]) -> None:
             )
 
 
+def collect_flightrec(doc: dict, partial_path: Optional[str]) -> None:
+    """Reference every flight-recorder dump this round produced (parent
+    watchdog dumps AND child dumps — they share the run's dump dir via
+    the inherited env) from the partial JSON, so a wedged section ships
+    its own post-mortem next to the numbers it failed to produce."""
+    from tendermint_tpu.libs import flightrec
+
+    d = flightrec.dump_dir()
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        names = []
+    dumps = []
+    for fname in names:
+        if not (fname.startswith("flightrec-") and fname.endswith(".json")):
+            continue
+        path = os.path.join(d, fname)
+        entry: Dict[str, object] = {"path": path}
+        try:
+            with open(path, "r") as f:
+                dumped = json.load(f)
+            entry["pid"] = dumped.get("pid")
+            entry["reason"] = dumped.get("reason")
+            entry["records"] = len(dumped.get("records") or [])
+        except (OSError, ValueError):
+            entry["error"] = "unreadable"
+        dumps.append(entry)
+    if dumps:
+        doc["flightrec_dumps"] = dumps
+        if partial_path:
+            results.write_partial(doc, partial_path)
+
+
 def run(
     plan: Optional[Tuple[str, ...]] = None,
     resume_path: Optional[str] = None,
     partial_path: Optional[str] = None,
 ) -> Tuple[dict, int]:
     """Full orchestration; returns (merged_doc, exit_code)."""
-    from tendermint_tpu.libs import tracing
+    from tendermint_tpu.libs import flightrec, tracing
 
     platform = os.environ.get("JAX_PLATFORMS", "default")
     if resume_path:
@@ -490,6 +523,12 @@ def run(
                 "BENCH_PARTIAL", os.path.join(REPO, "BENCH_partial.json")
             )
     doc.setdefault("probe", {})["configured_backend"] = platform
+
+    # Flight recorder: the parent's ring absorbs watchdog instants and
+    # runner metric deltas; children inherit the same dump dir through
+    # build_child_env, so one collection pass sees the whole fleet.
+    os.environ.setdefault(flightrec.DIR_ENV, partial_path + ".flightrec")
+    flightrec.install()
 
     if plan is None:
         # On resume, finish the round that was interrupted: prefer the
@@ -505,9 +544,12 @@ def run(
 
     run_sections(plan, doc, partial_path)
     mark_skipped(doc, partial_path)
+    collect_flightrec(doc, partial_path)
 
     merged = results.merge(doc, list(sections.ORDER))
     merged["runner_trace_summary"] = tracing.tracer.summary() or None
+    if doc.get("flightrec_dumps"):
+        merged["flightrec_dumps"] = doc["flightrec_dumps"]
     code = results.exit_code(doc)
 
     statuses = [b["status"] for b in doc["sections"].values()]

@@ -691,6 +691,99 @@ def run_verifyd_shm(beat) -> dict:
     return {"verifyd_shm": out}
 
 
+def run_latency_attrib(beat) -> dict:
+    """End-to-end latency attribution (ISSUE 15): the stage-time vector
+    every verifyd response carries must actually EXPLAIN the latency the
+    client observes, not merely decorate it. A modeled sleep verifier
+    makes the device stage dominant and deterministic (no jax), the
+    connection is warmed before measuring so channel setup does not
+    pollute the vector, and the section asserts the attributed stages
+    sum to >=90% of the client-observed p50 — if attribution ever drifts
+    (a stage boundary moves, a wait stops being counted), the bench
+    fails rather than silently reporting a vector nobody can trust."""
+    from tendermint_tpu.libs import tracing
+    from tendermint_tpu.verifyd import protocol
+    from tendermint_tpu.verifyd.client import VerifydClient
+    from tendermint_tpu.verifyd.server import VerifydServer
+
+    rounds = env_int("BENCH_ATTRIB_ROUNDS", 24)
+    n_lanes = env_int("BENCH_ATTRIB_LANES", 32)
+    lane_us = env_int("BENCH_ATTRIB_LANE_US", 400)
+
+    lanes = (
+        [b"\x05" * 32] * n_lanes,
+        [b"attrib-%d" % i for i in range(n_lanes)],
+        [b"\x06" * 64] * n_lanes,
+    )
+
+    def modeled(pks, msgs, sigs):
+        time.sleep(lane_us * 1e-6 * len(pks))
+        return [True] * len(pks)
+
+    prev_mode = tracing.tracer.mode
+    tracing.configure(tracing.RING)  # exemplars need a recording tracer
+    srv = VerifydServer(verify_fn=modeled, max_batch=n_lanes, max_delay=0.001)
+    srv.start()
+    host, port = srv.address
+    samples = []  # (wall_s, attributed_s) per measured call
+    try:
+        c = VerifydClient(f"{host}:{port}", fallback=False)
+        beat("connection warmup lanes=%d lane_us=%d" % (n_lanes, lane_us))
+        for _ in range(3):
+            c.verify(*lanes, klass=protocol.CLASS_CONSENSUS)
+        prev_totals = dict(c.stage_totals)
+        for i in range(rounds):
+            if i % 8 == 0:
+                beat("attrib round %d/%d" % (i, rounds))
+            t0 = time.perf_counter()
+            oks = c.verify(*lanes, klass=protocol.CLASS_CONSENSUS)
+            wall = time.perf_counter() - t0
+            if not all(oks):
+                raise AssertionError("modeled verify must pass")
+            attributed = sum(
+                v - prev_totals.get(k, 0.0)
+                for k, v in c.stage_totals.items()
+                if k != "transport"
+            )
+            prev_totals = dict(c.stage_totals)
+            samples.append((wall, attributed))
+        stage_totals = dict(c.stage_totals)
+        c.close()
+    finally:
+        srv.stop()
+        tracing.configure(prev_mode)
+
+    samples.sort(key=lambda s: s[0])
+    p50_wall, p50_attr = samples[len(samples) // 2]
+    p50_frac = p50_attr / p50_wall if p50_wall > 0 else 0.0
+    attributed_sum = sum(
+        v for k, v in stage_totals.items() if k != "transport"
+    )
+    frag = {
+        "rounds": rounds,
+        "lanes": n_lanes,
+        "lane_us": lane_us,
+        "p50_ms": round(p50_wall * 1e3, 3),
+        "p50_attributed_ms": round(p50_attr * 1e3, 3),
+        "p50_attributed_frac": round(p50_frac, 4),
+        "stage_ms": {
+            k: round(v * 1e3, 3) for k, v in sorted(stage_totals.items())
+        },
+        "transport_frac": round(
+            stage_totals.get("transport", 0.0)
+            / max(1e-12, attributed_sum + stage_totals.get("transport", 0.0)),
+            4,
+        ),
+    }
+    # the section's whole point: the vector explains the latency
+    if p50_frac < 0.9:
+        raise AssertionError(
+            "stage vector explains only %.1f%% of observed p50 "
+            "(need >=90%%): %r" % (p50_frac * 100.0, frag)
+        )
+    return {"latency_attrib": frag}
+
+
 def run_light_serve(beat) -> dict:
     """PR 9 serving-tier benchmark: an in-process lightd (selector event
     loop + verified-header cache) under BENCH_LIGHT_SERVE_CLIENTS
@@ -1027,6 +1120,16 @@ _ALL = (
             ("BENCH_SHM_ROUNDS", 12, 4),
         ),
         skip_env=("BENCH_SKIP_VERIFYD_SHM",),
+    ),
+    Section(
+        "latency_attrib",
+        run_latency_attrib,
+        needs_jax=False,
+        degrade=(
+            ("BENCH_ATTRIB_ROUNDS", 24, 8),
+            ("BENCH_ATTRIB_LANES", 32, 8),
+        ),
+        skip_env=("BENCH_SKIP_LATENCY_ATTRIB",),
     ),
     Section(
         "light_serve",

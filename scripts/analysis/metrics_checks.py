@@ -8,6 +8,13 @@
   resolve statically (``_name(s, "...")`` with a literal per-class
   ``s = "<subsystem>"``, or a string literal), match
   ``tendermint_[a-z0-9_]+``, and be globally unique.
+- TPM003 — exemplar binding: every ``observe(..., exemplar=...)`` call
+  site must chain from an instrument attribute that is declared in
+  ``libs/metrics.py`` **as a histogram**. TPM001 only catches the
+  declared-but-unreferenced direction; an exemplar-bearing call site
+  whose instrument was renamed away (or points at a counter/gauge,
+  where exemplars silently never render) is the reverse failure and
+  would otherwise ship dead trace-ID links.
 
 This is a project-level checker (it needs the whole package to find
 references), which is exactly why ``check_metrics.py`` could not stay a
@@ -30,7 +37,16 @@ METRICS_REL = "tendermint_tpu/libs/metrics.py"
 
 def declared_instruments(module: Module) -> Dict[str, Tuple[str, int]]:
     """attr -> (class, lineno) for every instrument declaration."""
-    out: Dict[str, Tuple[str, int]] = {}
+    return {
+        attr: (cls, lineno)
+        for attr, (cls, lineno, _kind) in instrument_kinds(module).items()
+    }
+
+
+def instrument_kinds(module: Module) -> Dict[str, Tuple[str, int, str]]:
+    """attr -> (class, lineno, factory kind) for every instrument
+    declaration (kind is ``counter``/``gauge``/``histogram``)."""
+    out: Dict[str, Tuple[str, int, str]] = {}
     for cls in ast.walk(module.tree):
         if not isinstance(cls, ast.ClassDef):
             continue
@@ -51,7 +67,7 @@ def declared_instruments(module: Module) -> Dict[str, Tuple[str, int]]:
                 and call.func.attr in _FACTORIES
             ):
                 continue
-            out[tgt.attr] = (cls.name, node.lineno)
+            out[tgt.attr] = (cls.name, node.lineno, call.func.attr)
     return out
 
 
@@ -64,6 +80,66 @@ def referenced_attrs(project: Project, skip_rel: str) -> Set[str]:
             if isinstance(node, ast.Attribute):
                 refs.add(node.attr)
     return refs
+
+
+def _exemplar_instrument_attr(call: ast.Call) -> Tuple[str, bool]:
+    """For an ``observe(...)`` call, resolve the instrument attribute at
+    the base of the chain (``X`` in ``...metrics.X.labels(...).observe``
+    or ``...metrics.X.observe``). Returns ("", False) when the base is a
+    bare name (local alias — not statically resolvable)."""
+    base = call.func.value  # type: ignore[attr-defined]
+    # unwrap a .labels(...) hop
+    if (
+        isinstance(base, ast.Call)
+        and isinstance(base.func, ast.Attribute)
+        and base.func.attr == "labels"
+    ):
+        base = base.func.value
+    if isinstance(base, ast.Attribute):
+        return base.attr, True
+    return "", False
+
+
+def exemplar_findings(
+    project: Project, metrics_mod: Module
+) -> Iterator[Finding]:
+    """TPM003: every exemplar-bearing observe must bind to a declared
+    histogram (see module docstring)."""
+    kinds = instrument_kinds(metrics_mod)
+    for mod in project.modules:
+        if mod.rel == metrics_mod.rel or not mod.rel.startswith(
+            "tendermint_tpu/"
+        ):
+            continue
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "observe"
+                and any(kw.arg == "exemplar" for kw in node.keywords)
+            ):
+                continue
+            attr, resolved = _exemplar_instrument_attr(node)
+            if not resolved:
+                continue  # local alias; the dynamic path still works
+            if attr not in kinds:
+                yield Finding(
+                    mod.rel,
+                    node.lineno,
+                    "TPM003",
+                    f"exemplar observed on '{attr}', which is not a "
+                    "declared instrument in libs/metrics.py (renamed "
+                    "away? the trace-ID link is dead)",
+                )
+            elif kinds[attr][2] != "histogram":
+                yield Finding(
+                    mod.rel,
+                    node.lineno,
+                    "TPM003",
+                    f"exemplar observed on '{attr}', a "
+                    f"{kinds[attr][2]} — exemplars only render on "
+                    "histogram buckets and would be silently dropped",
+                )
 
 
 def name_findings(module: Module) -> Iterator[Finding]:
@@ -153,6 +229,8 @@ class MetricsChecker(Checker):
     codes = {
         "TPM001": "instrument declared but never referenced (dead weight)",
         "TPM002": "metric exposition-name hygiene violation",
+        "TPM003": "exemplar bound to an undeclared or non-histogram "
+        "instrument",
     }
 
     def check_project(self, project: Project) -> Iterator[Finding]:
@@ -160,6 +238,7 @@ class MetricsChecker(Checker):
         if metrics_mod is None:
             return
         yield from name_findings(metrics_mod)
+        yield from exemplar_findings(project, metrics_mod)
         # the dead-instrument audit is only meaningful against the whole
         # package — on a partial file list every instrument looks dead
         if not any(

@@ -27,7 +27,12 @@ makes that reasoning mechanical for ``verifyd/protocol.py`` and
   as empty instead of the constant the encoder elided. Safe shapes are
   a decode-side ``x.attr = x.attr or DEFAULT`` normalization, a
   pre-loop ``attr = DEFAULT`` local, or the dataclass field default
-  being the same constant.
+  being the same constant. Truthiness-only guards (``if x.attr:``,
+  the zero-omission idiom the trace-context field rides) are held to
+  the same standard against the EMPTY default: the decode path must
+  pin ``x.attr = x.attr or b""`` (or the dataclass default must be
+  the empty literal), which is what proves an old frame without the
+  field decodes byte-identically to one that never carried it.
 - TPW005 — slab-header codec asymmetry (``verifyd/shm.py``): the
   shared-memory slab header is a fixed layout named by ``SLAB_OFF_*``
   constants, and ``pack_header``/``unpack_header`` must both touch
@@ -398,11 +403,7 @@ class WireCompatChecker(Checker):
     def _default_guard_const(
         self, parents: Dict[ast.AST, ast.AST], node: ast.Call, attr: str
     ) -> Optional[str]:
-        """CONST name in an enclosing ``if x.attr != CONST`` guard.
-
-        Truthiness-only guards (``if x.attr:``) omit the empty string,
-        whose decode default IS empty — those are safe and return None.
-        """
+        """CONST name in an enclosing ``if x.attr != CONST`` guard."""
         cur: Optional[ast.AST] = node
         while cur is not None:
             cur = parents.get(cur)
@@ -429,6 +430,75 @@ class WireCompatChecker(Checker):
                 if attrs and names:
                     return names[0].id
         return None
+
+    def _truthiness_guard(
+        self, parents: Dict[ast.AST, ast.AST], node: ast.Call, attr: str
+    ) -> bool:
+        """Is this emit inside an ``if x.attr:`` truthiness guard?
+
+        A truthiness guard omits the empty value — proto3 zero-omission
+        for string/bytes fields. That is only safe when the decode path
+        provably re-establishes the empty default for absent fields
+        (``_reestablishes_empty``); otherwise a field added later (the
+        trace-context field is the canonical case) silently breaks the
+        old-frames-decode-byte-identically guarantee the moment anyone
+        gives the dataclass a non-empty default.
+        """
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            cur = parents.get(cur)
+            if not isinstance(cur, ast.If):
+                continue
+            tests = (
+                cur.test.values
+                if isinstance(cur.test, ast.BoolOp)
+                else [cur.test]
+            )
+            for test in tests:
+                if isinstance(test, ast.Attribute) and test.attr == attr:
+                    return True
+        return False
+
+    def _reestablishes_empty(self, module: Module, attr: str) -> bool:
+        """Does a decode path (or the dataclass default) pin ``attr``
+        to the EMPTY value an omitted field must decode as?
+
+        Accepted: ``x.attr = x.attr or b""`` (post-parse
+        normalization), ``attr = b""`` pre-loop local, or a dataclass
+        ``attr: bytes = b""`` field default — each with ``""`` for
+        string fields.
+        """
+
+        def empty_const(v: ast.AST) -> bool:
+            return isinstance(v, ast.Constant) and v.value in ("", b"")
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                targets_attr = any(
+                    (isinstance(t, ast.Attribute) and t.attr == attr)
+                    or (isinstance(t, ast.Name) and t.id == attr)
+                    for t in node.targets
+                )
+                if not targets_attr:
+                    continue
+                # `x.attr = x.attr or b""`
+                if isinstance(node.value, ast.BoolOp) and isinstance(
+                    node.value.op, ast.Or
+                ):
+                    if any(empty_const(v) for v in node.value.values):
+                        return True
+                # pre-loop local: `attr = b""`
+                if empty_const(node.value):
+                    return True
+            if (
+                isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == attr
+                and node.value is not None
+                and empty_const(node.value)
+            ):
+                return True
+        return False
 
     def _reestablishes(self, module: Module, attr: str, const: str) -> bool:
         """Does any decode path restore ``attr`` to ``const``?"""
@@ -488,16 +558,30 @@ class WireCompatChecker(Checker):
             if attr is None:
                 continue
             const = self._default_guard_const(parents, node, attr)
-            if const is None:
+            if const is not None:
+                if self._reestablishes(module, attr, const):
+                    continue
+                yield Finding(
+                    module.rel,
+                    node.lineno,
+                    "TPW004",
+                    f"field '{attr}' is omitted when it equals {const}, "
+                    "but no decode path re-establishes that default; an "
+                    f"omitted field decodes as empty, not {const} — add "
+                    f"`x.{attr} = x.{attr} or {const}` after parsing",
+                )
                 continue
-            if self._reestablishes(module, attr, const):
-                continue
-            yield Finding(
-                module.rel,
-                node.lineno,
-                "TPW004",
-                f"field '{attr}' is omitted when it equals {const}, but "
-                "no decode path re-establishes that default; an omitted "
-                f"field decodes as empty, not {const} — add "
-                f"`x.{attr} = x.{attr} or {const}` after parsing",
-            )
+            if self._truthiness_guard(parents, node, attr):
+                if self._reestablishes_empty(module, attr):
+                    continue
+                yield Finding(
+                    module.rel,
+                    node.lineno,
+                    "TPW004",
+                    f"field '{attr}' is zero-omitted (truthiness guard) "
+                    "but no decode path pins the empty default; old "
+                    "frames without the field must decode "
+                    f"byte-identically — add `x.{attr} = x.{attr} or "
+                    "b\"\"` (or an empty dataclass default) after "
+                    "parsing",
+                )

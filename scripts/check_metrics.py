@@ -72,6 +72,17 @@ def find_dead_instruments() -> list:
     )
 
 
+def find_exemplar_problems() -> list:
+    """TPM003 findings as strings: exemplar-bearing observe call sites
+    whose instrument is undeclared or not a histogram."""
+    modules = load_modules([PACKAGE], repo_root=REPO)
+    metrics_mod = _load(METRICS_PY)
+    return [
+        f"{f.path}:{f.line}: {f.message}"
+        for f in _mc.exemplar_findings(Project(modules), metrics_mod)
+    ]
+
+
 def main() -> int:
     decls = declared_instruments()
     dead = find_dead_instruments()
@@ -89,11 +100,15 @@ def main() -> int:
     for problem in hygiene["problems"]:
         print(f"METRIC NAME {problem}", file=sys.stderr)
         rc = 1
+    exemplar_problems = find_exemplar_problems()
+    for problem in exemplar_problems:
+        print(f"EXEMPLAR BINDING {problem}", file=sys.stderr)
+        rc = 1
     if rc == 0:
         print(
             f"ok: all {len(decls)} declared instruments are referenced;"
             f" {len(hygiene['names'])} exposition names unique and"
-            f" well-formed"
+            f" well-formed; exemplar-bearing histograms bound"
         )
     return rc
 

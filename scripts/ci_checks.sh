@@ -89,6 +89,7 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu \
     BENCH_SECTION_ATTEMPTS=1 BENCH_HOST_REF_SIGS=4 \
     BENCH_PARTIAL=/tmp/_bench_smoke/partial.json \
     BENCH_PROBE_LOG=/tmp/_bench_smoke/probe.md \
+    TENDERMINT_TPU_FLIGHTREC_DIR=/tmp/_bench_smoke/flightrec \
     python bench.py > /tmp/_bench_smoke/out.json 2>/tmp/_bench_smoke/err.log
 bench_rc=$?
 if [ "$bench_rc" -eq 124 ]; then
@@ -110,10 +111,28 @@ assert secs["_chaos"]["status"] == "timeout", secs
 assert "heartbeat silence" in (secs["_chaos"]["note"] or ""), secs
 # killed by the heartbeat watchdog inside its window, not the wall budget
 assert secs["_chaos"]["duration_s"] < 30, secs
-json.load(open("/tmp/_bench_smoke/partial.json"))  # schema-valid on disk
+partial = json.load(open("/tmp/_bench_smoke/partial.json"))  # schema-valid
+# flight recorder (ISSUE 15): the watchdog kill must leave a parseable
+# post-mortem dump referenced from the partial JSON — the child dies by
+# SIGKILL, so the PARENT's ring (which emits the kill instant) is the
+# dump under test
+dumps = [
+    d for d in partial.get("flightrec_dumps", [])
+    if d.get("reason") == "watchdog_kill"
+]
+assert dumps, partial.get("flightrec_dumps")
+rec = json.load(open(dumps[0]["path"]))
+assert rec["schema"].startswith("tendermint-tpu-flightrec/"), rec["schema"]
+assert any(
+    r["name"] == "bench_watchdog_kill" for r in rec["records"]
+), [r["name"] for r in rec["records"]][:20]
+assert merged.get("flightrec_dumps") == partial["flightrec_dumps"], (
+    "merged doc lost the dump references"
+)
 print(
-    "bench smoke ok: hang killed by watchdog in %.1fs, healthy section kept"
-    % secs["_chaos"]["duration_s"]
+    "bench smoke ok: hang killed by watchdog in %.1fs, healthy section "
+    "kept, flight recorder dumped %d records"
+    % (secs["_chaos"]["duration_s"], len(rec["records"]))
 )
 EOF
 
@@ -336,6 +355,38 @@ print(
        big["shm"]["codec_bytes_avoided"])
 )
 EOF
+
+echo "== flight recorder: sanitized ring tests + seeded explore =="
+# ISSUE 15 stage: the always-on flight recorder records from every
+# tracer span, metric increment, and fault hook concurrently — its
+# byte-accounting ring runs under happens-before race detection, then
+# the producer/reader/dumper hand-off explores 10 seeded
+# interleavings (TestRingConcurrency is the designated target class).
+rm -f /tmp/_tpusan_flightrec.log
+timeout -k 10 300 env TENDERMINT_TPU_SANITIZE=hb JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_flightrec.py -q -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_tpusan_flightrec.log
+[ "${PIPESTATUS[0]}" -ne 0 ] && rc_total=1
+if grep -q "DATA RACE" /tmp/_tpusan_flightrec.log; then
+    echo "flightrec: data race detected (stacks above)" >&2
+    rc_total=1
+fi
+if grep -q "LOCK-ORDER CYCLE" /tmp/_tpusan_flightrec.log; then
+    echo "flightrec: lock-order cycle detected" >&2
+    rc_total=1
+fi
+for seed in 0 1 2 3 4 5 6 7 8 9; do
+    timeout -k 10 180 env TENDERMINT_TPU_SANITIZE=explore:$seed \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/test_flightrec.py::TestRingConcurrency" -q \
+        -p no:cacheprovider -p no:xdist -p no:randomly \
+        > /tmp/_tpusan_flightrec_explore.log 2>&1 || {
+        echo "flightrec explore: FAILED under seed $seed — replay with" \
+             "TENDERMINT_TPU_SANITIZE=explore:$seed" >&2
+        tail -20 /tmp/_tpusan_flightrec_explore.log >&2
+        rc_total=1
+    }
+done
 
 echo "== tier-1 pytest =="
 set -o pipefail

@@ -1,0 +1,223 @@
+#!/usr/bin/env python3
+"""Fuse per-process Chrome-trace exports into one fleet timeline.
+
+Every process in the serving fleet (node, lightd, verifyd, bench
+children) exports its own ring via ``tracing.tracer.export()`` — each
+on its OWN perf-counter epoch. This tool merges N such exports into a
+single Chrome ``trace_events`` document on one shared (unix-epoch)
+time base, keyed by the cross-process ``trace_id`` the wire protocols
+propagate (verifyd protocol field 7, shm slab trace words, JSON-RPC
+``trace`` member):
+
+- **base alignment**: each export carries ``otherData.epoch_unix_us``
+  (the wall-clock instant of its perf-counter epoch); event ``ts``
+  values shift onto that base, so the merged timeline is absolute;
+- **clock-skew correction**: wall clocks disagree across processes by
+  more than span durations, so after base alignment the merger
+  tightens each document's offset against the causal edges the trace
+  ids give us: a child span (server dispatch) can never START before
+  its remote parent (client call) started. For each cross-document
+  parent/child edge the required shift is computed and the document
+  slides by the minimum correction that makes every edge causal;
+- **linkage**: span ancestry uses the ``span_id``/``parent_span_id``
+  event keys; ``sched_trace_link`` instants add EXTRA parents — a
+  coalesced waiter whose lane rode another request's dispatch still
+  reaches the dispatch span from its own ``verifyd_call``.
+
+Usage::
+
+    python scripts/trace_merge.py merged.json client.json server.json
+    python -m scripts.trace_merge merged.json exports/*.json
+
+Import surface (tests, bench): ``merge(docs)``, ``load(path)``,
+``span_index(doc)``, ``ancestors(doc, span_id)``,
+``is_ancestor(doc, ancestor_span_id, span_id)``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, Iterable, List, Optional, Set
+
+MERGED_SCHEMA = "tendermint-tpu-trace-merge/1"
+
+# instants that declare an extra cross-trace parent edge: the instant's
+# ENCLOSING span (its parent_span_id) is additionally a child of
+# args.link_span_id (the coalesced waiter's client span)
+LINK_INSTANT = "sched_trace_link"
+
+
+def load(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _events(doc: dict) -> List[dict]:
+    return list(doc.get("traceEvents", []))
+
+
+def _epoch_us(doc: dict) -> float:
+    other = doc.get("otherData") or {}
+    try:
+        return float(other.get("epoch_unix_us", 0.0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _span_starts(events: Iterable[dict]) -> Dict[str, float]:
+    """span_id -> start ts for every complete (ph=X) event."""
+    out: Dict[str, float] = {}
+    for ev in events:
+        sid = ev.get("span_id")
+        if sid and ev.get("ph") == "X" and "ts" in ev:
+            out[sid] = ev["ts"]
+    return out
+
+
+def _skew_corrections(docs: List[dict], shifted: List[List[dict]]) -> List[float]:
+    """Per-document extra offsets (us) making every cross-document
+    parent->child edge causal (child start >= parent start). Documents
+    are corrected independently against the union of the OTHERS'
+    spans; a fleet is a star around the client in practice, so this
+    one-round correction is sufficient and keeps the math obvious."""
+    corrections = [0.0] * len(docs)
+    # global parent start table (first round, uncorrected)
+    starts: Dict[str, float] = {}
+    owner: Dict[str, int] = {}
+    for i, evs in enumerate(shifted):
+        for sid, ts in _span_starts(evs).items():
+            starts[sid] = ts
+            owner[sid] = i
+    for i, evs in enumerate(shifted):
+        worst = 0.0
+        for ev in evs:
+            pid = ev.get("parent_span_id")
+            if not pid or pid not in starts or owner.get(pid) == i:
+                continue  # intra-document edges are already consistent
+            ts = ev.get("ts")
+            if ts is None:
+                continue
+            lag = starts[pid] - ts  # >0: child apparently before parent
+            if lag > worst:
+                worst = lag
+        corrections[i] = worst
+    return corrections
+
+
+def merge(docs: List[dict]) -> dict:
+    """Merge N per-process export documents into one timeline dict."""
+    shifted: List[List[dict]] = []
+    for doc in docs:
+        base = _epoch_us(doc)
+        evs = []
+        for ev in _events(doc):
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + base
+            evs.append(ev)
+        shifted.append(evs)
+    corrections = _skew_corrections(docs, shifted)
+    merged: List[dict] = []
+    for i, evs in enumerate(shifted):
+        corr = corrections[i]
+        for ev in evs:
+            if corr and "ts" in ev:
+                ev["ts"] = ev["ts"] + corr
+            merged.append(ev)
+    merged.sort(key=lambda e: e.get("ts", 0.0))
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "schema": MERGED_SCHEMA,
+            "merged_from": len(docs),
+            "skew_corrections_us": corrections,
+        },
+    }
+
+
+# --- linkage queries --------------------------------------------------------
+
+
+def span_index(doc: dict) -> Dict[str, dict]:
+    """span_id -> complete event, over a merged (or single) document."""
+    return {
+        ev["span_id"]: ev
+        for ev in _events(doc)
+        if ev.get("span_id") and ev.get("ph") == "X"
+    }
+
+
+def _parent_edges(doc: dict) -> Dict[str, Set[str]]:
+    """span_id -> set of parent span_ids (direct ancestry plus the
+    extra edges sched_trace_link instants declare)."""
+    edges: Dict[str, Set[str]] = {}
+    for ev in _events(doc):
+        sid = ev.get("span_id")
+        pid = ev.get("parent_span_id")
+        if sid and pid:
+            edges.setdefault(sid, set()).add(pid)
+        if ev.get("name") == LINK_INSTANT and ev.get("ph") == "i":
+            # the instant's enclosing span gains the linked client span
+            # as an extra parent
+            host = ev.get("parent_span_id")
+            extra = (ev.get("args") or {}).get("link_span_id")
+            if host and extra:
+                edges.setdefault(host, set()).add(extra)
+    return edges
+
+
+def ancestors(doc: dict, span_id: str) -> Set[str]:
+    """Every span_id reachable parent-ward from ``span_id``."""
+    edges = _parent_edges(doc)
+    seen: Set[str] = set()
+    frontier = list(edges.get(span_id, ()))
+    while frontier:
+        cur = frontier.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        frontier.extend(edges.get(cur, ()))
+    return seen
+
+
+def is_ancestor(doc: dict, ancestor_span_id: str, span_id: str) -> bool:
+    return ancestor_span_id in ancestors(doc, span_id)
+
+
+def spans_named(doc: dict, name: str) -> List[dict]:
+    return [
+        ev
+        for ev in _events(doc)
+        if ev.get("name") == name and ev.get("ph") == "X"
+    ]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 2:
+        print(
+            "usage: trace_merge.py OUT.json IN1.json [IN2.json ...]",
+            file=sys.stderr,
+        )
+        return 2
+    out_path, in_paths = argv[0], argv[1:]
+    docs = [load(p) for p in in_paths]
+    doc = merge(docs)
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    traces = {
+        ev.get("trace_id")
+        for ev in doc["traceEvents"]
+        if ev.get("trace_id")
+    }
+    print(
+        f"merged {len(in_paths)} exports -> {out_path}: "
+        f"{len(doc['traceEvents'])} events, {len(traces)} traces"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -566,6 +566,11 @@ def cmd_verifyd(args) -> int:
     stop = []
     signal.signal(signal.SIGINT, lambda *_: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    # installed AFTER the daemon's own handlers so a SIGTERM dumps the
+    # flight-recorder ring first and then chains into the graceful stop
+    from tendermint_tpu.libs import flightrec
+
+    flightrec.install()
     server.start()
     if metrics_server is not None:
         metrics_server.start()
@@ -641,6 +646,11 @@ def cmd_lightd(args) -> int:
     stop = []
     signal.signal(signal.SIGINT, lambda *_: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    # installed AFTER the daemon's own handlers so a SIGTERM dumps the
+    # flight-recorder ring first and then chains into the graceful stop
+    from tendermint_tpu.libs import flightrec
+
+    flightrec.install()
     server.start()
     print(
         f"lightd for {args.chain_id} on {server.url} "

@@ -113,6 +113,16 @@ class _Pending:
     flush_by: Optional[float] = None  # absolute monotonic wire deadline
     tag: Optional[object] = None  # submitter identity (e.g. connection)
     tenant: Optional[str] = None  # namespace label (multi-tenant verifyd)
+    # cross-process causality (ISSUE 15): the submitter's TraceContext;
+    # the dispatch span links under it (first distinct ctx) and every
+    # further distinct ctx gets a sched_trace_link instant — including
+    # a waiter whose lane coalesced into another entry's slot.
+    trace: Optional[tracing.TraceContext] = None
+    # stage-attribution timestamps (monotonic), written by _flush_one:
+    # batch residency = t_dispatch - submitted, device = t_done -
+    # t_dispatch, collect = respond time - t_done (server-side).
+    t_dispatch: float = 0.0
+    t_done: float = 0.0
 
     def due(self, max_delay: float) -> float:
         """Absolute monotonic time this entry must be flushed by."""
@@ -263,10 +273,13 @@ class VerifyScheduler:
         flush_by: Optional[float] = None,
         tag: Optional[object] = None,
         tenant: Optional[str] = None,
+        trace: Optional[tracing.TraceContext] = None,
     ) -> _Pending:
         """Enqueue one signature; returns a handle for ``wait``. Callers
         with several signatures submit all first so one flush covers
         them, instead of paying the deadline once per signature."""
+        if trace is None:
+            trace = tracing.current_context()
         entry = _Pending(
             pubkey,
             msg,
@@ -276,6 +289,7 @@ class VerifyScheduler:
             flush_by=flush_by,
             tag=tag,
             tenant=tenant,
+            trace=trace,
         )
         with self._wake:
             if self._stop or self._thread is None:
@@ -306,6 +320,7 @@ class VerifyScheduler:
         flush_by: Optional[float] = None,
         tag: Optional[object] = None,
         tenant: Optional[str] = None,
+        trace: Optional[tracing.TraceContext] = None,
     ) -> List[_Pending]:
         """Atomically enqueue a whole lane group under ONE lock round and
         ONE accumulator wake-up. This is the super-batch entry point for
@@ -316,9 +331,11 @@ class VerifyScheduler:
         pull the flush immediately and spend exactly one device call on
         the group."""
         now = time.monotonic()
+        if trace is None:
+            trace = tracing.current_context()
         entries = [
             _Pending(pk, msg, sig, now, priority=priority,
-                     flush_by=flush_by, tag=tag, tenant=tenant)
+                     flush_by=flush_by, tag=tag, tenant=tenant, trace=trace)
             for pk, msg, sig in lanes
         ]
         with self._wake:
@@ -531,9 +548,33 @@ class VerifyScheduler:
         index: dict = {}
         slots: List[int] = []
         had_error = used_fallback = False
+        # Distinct submitter trace contexts in batch order.  The first
+        # becomes the dispatch span's remote parent; every other distinct
+        # context — including a waiter whose lane coalesces into another
+        # entry's slot — is linked via a sched_trace_link instant so the
+        # merged fleet timeline still reaches its client span.
+        t_dispatch = time.monotonic()
+        traces: List[tracing.TraceContext] = []
+        seen_tids: set = set()
+        for p in batch:
+            p.t_dispatch = t_dispatch
+            ctx = p.trace
+            if ctx is not None and ctx.trace_id not in seen_tids:
+                seen_tids.add(ctx.trace_id)
+                traces.append(ctx)
         with tracing.span(
-            "scheduler_dispatch", lanes=len(batch), reason=reason, depth=depth
+            "scheduler_dispatch",
+            parent_ctx=traces[0] if traces else None,
+            lanes=len(batch),
+            reason=reason,
+            depth=depth,
         ):
+            for ctx in traces[1:16]:
+                tracing.instant(
+                    "sched_trace_link",
+                    link_trace_id=ctx.trace_id,
+                    link_span_id=ctx.span_id,
+                )
             with tracing.span("sched_assemble", lanes=len(batch)) as asp:
                 for p in batch:
                     # Zero-copy ingress (verifyd/shm.py) submits lanes as
@@ -587,6 +628,8 @@ class VerifyScheduler:
         # observers run strictly-before the futures resolve, so a
         # waiter that wakes can already see its flush accounted for
         self._notify_flush(reason, batch, time.monotonic() - t0)
+        t_done = time.monotonic()
         for p, idx in zip(batch, slots):
             p.ok = bool(oks[idx])
+            p.t_done = t_done
             p.done.set()

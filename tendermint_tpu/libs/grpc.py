@@ -486,6 +486,10 @@ class GrpcChannel:
             return self._conn
         sock = socket.create_connection(self._addr, timeout=self._timeout)
         sock.settimeout(self._timeout)
+        # every call is a write-write-read (HEADERS frame, DATA frame,
+        # then block on the response): with Nagle on, the DATA frame sits
+        # behind a delayed ACK and every RPC eats a flat ~40ms stall
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.sendall(PREFACE)
         write_frame(sock, FRAME_SETTINGS, 0, 0, _settings_payload())
         # open up the connection-level receive window for the peer
@@ -1040,6 +1044,12 @@ class GrpcServer:
             # that vanished without FIN.
             sock.settimeout(None)
             sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            # responses are HEADERS + DATA + trailers in separate writes;
+            # without NODELAY the tail frames wait out the client's
+            # delayed ACK and the caller sees it as transport time
+            # (TCP-only: tests drive this loop over AF_UNIX socketpairs)
+            if sock.family in (socket.AF_INET, socket.AF_INET6):
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             machine = _H2ServerConn(self, sock.sendall)
             while not self._stop.is_set():
                 data = sock.recv(65536)

@@ -15,9 +15,30 @@ as the reference's NopMetrics constructors.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 NAMESPACE = "tendermint"
+
+# Flight-recorder sink (libs/flightrec installs itself here): counter
+# increments and gauge sets mirror into the post-mortem ring. Read
+# racily on the hot path, same contract as the tracer's observer slot —
+# a mid-install event lands in the old or new sink, either is fine.
+_flight_sink: Optional[Callable[[str, Tuple, float], None]] = None
+
+
+def set_flight_sink(fn: Optional[Callable[[str, Tuple, float], None]]) -> None:
+    global _flight_sink
+    _flight_sink = fn
+
+
+def _flight_note(name: str, key: Tuple, value: float) -> None:
+    sink = _flight_sink
+    if sink is not None:
+        try:
+            sink(name, key, value)
+        except Exception:
+            pass  # the post-mortem ring must never fail a metric write
 
 DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
@@ -103,6 +124,7 @@ class _BoundCounter:
             raise ValueError("counters only go up")
         with self._m._lock:
             self._m._values[self._k] = self._m._values.get(self._k, 0.0) + n
+        _flight_note(self._m.name, self._k, n)
 
 
 class Gauge(_Metric):
@@ -146,10 +168,13 @@ class _BoundGauge:
     def set(self, v: float) -> None:
         with self._m._lock:
             self._m._values[self._k] = float(v)
+        _flight_note(self._m.name, self._k, v)
 
     def inc(self, n: float = 1.0) -> None:
         with self._m._lock:
-            self._m._values[self._k] = self._m._values.get(self._k, 0.0) + n
+            v = self._m._values.get(self._k, 0.0) + n
+            self._m._values[self._k] = v
+        _flight_note(self._m.name, self._k, v)
 
     def dec(self, n: float = 1.0) -> None:
         self.inc(-n)
@@ -169,14 +194,21 @@ class Histogram(_Metric):
         self.buckets = tuple(sorted(buckets))
         # per label key: (bucket counts, sum, count)
         self._values: Dict[Tuple, Tuple[List[int], float, int]] = {}  # guarded-by: _lock
+        # per (label key, bucket index): last (exemplar labels, value,
+        # unix ts) — bounded by keys x (buckets+1), OpenMetrics-style
+        self._exemplars: Dict[Tuple[Tuple, int], Tuple[Dict[str, str], float, float]] = {}  # guarded-by: _lock
 
     def labels(self, **labels: str) -> "_BoundHistogram":
         return _BoundHistogram(self, _label_key(labels))
 
-    def observe(self, v: float) -> None:
-        self.labels().observe(v)
+    def observe(self, v: float, exemplar: Optional[Dict[str, str]] = None) -> None:
+        self.labels().observe(v, exemplar=exemplar)
 
-    def collect(self) -> List[str]:
+    def has_exemplars(self) -> bool:
+        with self._lock:
+            return bool(self._exemplars)
+
+    def collect(self, exemplars: bool = False) -> List[str]:
         with self._lock:
             # deep-copy counts: observe() mutates the aliased list in
             # place, and a torn snapshot yields non-monotonic buckets
@@ -184,24 +216,38 @@ class Histogram(_Metric):
                 (k, (list(c), t, n))
                 for k, (c, t, n) in self._values.items()
             )
+            exem = dict(self._exemplars) if exemplars else {}
         out: List[str] = []
         for key, (counts, total, n) in items:
             cum = 0
-            for b, c in zip(self.buckets, counts):
+            for i, (b, c) in enumerate(zip(self.buckets, counts)):
                 cum += c
                 lk = dict(key)
                 lk["le"] = _fmt(b)
-                out.append(
-                    f"{self.name}_bucket{_label_str(_label_key(lk))} {cum}"
-                )
+                line = f"{self.name}_bucket{_label_str(_label_key(lk))} {cum}"
+                out.append(line + _exemplar_suffix(exem.get((key, i))))
             lk = dict(key)
             lk["le"] = "+Inf"
+            line = f"{self.name}_bucket{_label_str(_label_key(lk))} {n}"
             out.append(
-                f"{self.name}_bucket{_label_str(_label_key(lk))} {n}"
+                line + _exemplar_suffix(exem.get((key, len(self.buckets))))
             )
             out.append(f"{self.name}_sum{_label_str(key)} {_fmt(total)}")
             out.append(f"{self.name}_count{_label_str(key)} {n}")
         return out
+
+
+def _exemplar_suffix(
+    ex: Optional[Tuple[Dict[str, str], float, float]]
+) -> str:
+    """OpenMetrics exemplar rendering: `` # {trace_id="..."} v ts``.
+    Empty when the bucket has no exemplar (plain exposition stays
+    byte-identical unless exemplars were requested AND recorded)."""
+    if ex is None:
+        return ""
+    labels, v, ts = ex
+    inner = ",".join(f'{k}="{_escape(val)}"' for k, val in sorted(labels.items()))
+    return " # {%s} %s %s" % (inner, _fmt(round(v, 9)), _fmt(round(ts, 3)))
 
 
 class _BoundHistogram:
@@ -211,8 +257,9 @@ class _BoundHistogram:
         self._m = metric
         self._k = key
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[Dict[str, str]] = None) -> None:
         m = self._m
+        bucket = len(m.buckets)  # +Inf
         with m._lock:
             counts, total, n = m._values.get(
                 self._k, ([0] * len(m.buckets), 0.0, 0)
@@ -220,8 +267,13 @@ class _BoundHistogram:
             for i, b in enumerate(m.buckets):
                 if v <= b:
                     counts[i] += 1
+                    bucket = i
                     break
             m._values[self._k] = (counts, total + v, n + 1)
+            if exemplar:
+                m._exemplars[(self._k, bucket)] = (
+                    dict(exemplar), v, time.time()
+                )
 
 
 class Registry:
@@ -253,14 +305,20 @@ class Registry:
     ) -> Histogram:
         return self.register(Histogram(name, help_, labels, buckets))  # type: ignore[return-value]
 
-    def expose(self) -> str:
+    def expose(self, exemplars: bool = False) -> str:
+        """Text exposition; ``exemplars=True`` appends OpenMetrics-style
+        trace-ID exemplars to histogram bucket lines (the default stays
+        plain-Prometheus-parseable)."""
         lines: List[str] = []
         with self._lock:
             metrics = list(self._metrics)
         for m in metrics:
             lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.kind}")
-            lines.extend(m.collect())
+            if exemplars and isinstance(m, Histogram):
+                lines.extend(m.collect(exemplars=True))
+            else:
+                lines.extend(m.collect())
         return "\n".join(lines) + "\n"
 
 
@@ -608,6 +666,20 @@ class VerifydMetrics(_NopMixin):
             _name(s, "tenant_request_seconds"),
             "Wire latency per request, by tenant namespace.",
             labels=("tenant",),
+        )
+        # Client-side end-to-end latency attribution (verifyd/client.py):
+        # the server's per-response stage-time vector observed one
+        # histogram sample per stage, with trace-ID exemplars linking a
+        # bucket back to the causal trace (ISSUE 15).
+        self.e2e_stage_seconds = reg.histogram(
+            _name(s, "e2e_stage_seconds"),
+            "Per-stage share of verifyd request latency as attributed"
+            " by the server's stage-time vector, seconds.",
+            labels=("stage",),
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+            ),
         )
         self.host_direct_lanes = reg.counter(
             _name(s, "host_direct_lanes_total"),

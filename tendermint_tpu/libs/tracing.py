@@ -29,17 +29,31 @@ the trace always agree — one clock, one count.
 Nesting is per thread (a thread-local span stack); concurrency is safe
 because each thread only touches its own stack and the ring append
 takes the tracer lock.
+
+Fleet scope (ISSUE 15): every recorded span carries a ``trace_id`` /
+``span_id`` / ``parent_span_id``, and a compact :class:`TraceContext`
+(17 bytes on the wire) rides verifyd frames, shm slab headers, and
+JSON-RPC requests so a client's causal span and the server-side
+scheduler/dispatch spans it provoked share one trace. ``attach()``
+splices a remote parent into the local thread's span stack;
+``current_context()`` reads the innermost active span for propagation.
+``scripts/trace_merge.py`` fuses per-process exports (each export
+records ``epoch_unix_us``, the wall-clock anchor of its perf-counter
+epoch, for clock-skew correction).
 """
 
 from __future__ import annotations
 
 import atexit
+import itertools
 import json
 import os
+import struct
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, NamedTuple, Optional
 
 TRACE_ENV = "TENDERMINT_TPU_TRACE"
 CAP_ENV = "TENDERMINT_TPU_TRACE_CAP"
@@ -47,6 +61,81 @@ DEFAULT_CAP = 4096
 
 OFF = "off"
 RING = "ring"
+
+# --- cross-process trace context ---------------------------------------------
+
+_CTX_STRUCT = struct.Struct("<8s8sB")  # trace_id, span_id, flags
+CTX_WIRE_LEN = _CTX_STRUCT.size  # 17 bytes
+
+# Span IDs: a per-process random prefix + a monotonically increasing
+# suffix. itertools.count is atomic under the GIL, so the hot path pays
+# no lock and no urandom read per span.
+_ID_PREFIX = os.urandom(4).hex()
+_ID_COUNTER = itertools.count(1)
+
+
+def _new_span_id() -> str:
+    return "%s%08x" % (_ID_PREFIX, next(_ID_COUNTER) & 0xFFFFFFFF)
+
+
+def _new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+class TraceContext(NamedTuple):
+    """Compact propagation context: 16-hex-char trace and span IDs plus
+    a flags byte (bit 0 = sampled). ``to_bytes`` is the 17-byte wire
+    form carried by verifyd frames and shm slab headers; ``to_header``
+    is the string form for JSON-RPC request members."""
+
+    trace_id: str
+    span_id: str
+    flags: int = 1
+
+    def to_bytes(self) -> bytes:
+        return _CTX_STRUCT.pack(
+            bytes.fromhex(self.trace_id), bytes.fromhex(self.span_id), self.flags
+        )
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> Optional["TraceContext"]:
+        if len(raw) != CTX_WIRE_LEN:
+            return None
+        tid, sid, flags = _CTX_STRUCT.unpack(raw)
+        if tid == b"\x00" * 8:
+            return None
+        return cls(tid.hex(), sid.hex(), flags)
+
+    def to_header(self) -> str:
+        return "%s-%s-%02x" % (self.trace_id, self.span_id, self.flags)
+
+    @classmethod
+    def from_header(cls, header: Any) -> Optional["TraceContext"]:
+        if not isinstance(header, str):
+            return None
+        parts = header.split("-")
+        if len(parts) != 3 or len(parts[0]) != 16 or len(parts[1]) != 16:
+            return None
+        try:
+            bytes.fromhex(parts[0])
+            bytes.fromhex(parts[1])
+            flags = int(parts[2], 16)
+        except ValueError:
+            return None
+        return cls(parts[0], parts[1], flags)
+
+
+class _RemoteAnchor:
+    """A remote parent spliced into the thread's span stack by
+    ``attach()``: children link under the caller's span_id without a
+    local span event being recorded for the anchor itself."""
+
+    __slots__ = ("name", "trace_id", "span_id")
+
+    def __init__(self, ctx: TraceContext):
+        self.name = "remote"
+        self.trace_id = ctx.trace_id
+        self.span_id = ctx.span_id
 
 
 class _NopSpan:
@@ -72,23 +161,59 @@ NOP_SPAN = _NopSpan()
 class _Span:
     """One live span; a context manager recording on exit."""
 
-    __slots__ = ("_tracer", "name", "args", "parent", "_t0")
+    __slots__ = (
+        "_tracer",
+        "name",
+        "args",
+        "parent",
+        "_t0",
+        "trace_id",
+        "span_id",
+        "parent_span_id",
+        "_remote",
+    )
 
-    def __init__(self, tracer: "Tracer", name: str, args: Dict[str, Any]):
+    def __init__(
+        self,
+        tracer: "Tracer",
+        name: str,
+        args: Dict[str, Any],
+        remote: Optional[TraceContext] = None,
+    ):
         self._tracer = tracer
         self.name = name
         self.args = args
         self.parent = ""
         self._t0 = 0.0
+        self.trace_id = ""
+        self.span_id = ""
+        self.parent_span_id = ""
+        self._remote = remote
 
     def set(self, **tags: Any) -> None:
         """Attach tags discovered mid-span (hit counts, verdicts)."""
         self.args.update(tags)
 
+    def context(self) -> TraceContext:
+        """Propagation context naming this span as the remote parent."""
+        return TraceContext(self.trace_id, self.span_id, 1)
+
     def __enter__(self) -> "_Span":
         stack = self._tracer._stack()
-        if stack:
-            self.parent = stack[-1].name
+        if self._remote is not None:
+            # explicit remote parent beats local nesting: this span IS
+            # the local continuation of the caller's cross-process span
+            self.parent = "remote"
+            self.trace_id = self._remote.trace_id
+            self.parent_span_id = self._remote.span_id
+        elif stack:
+            top = stack[-1]
+            self.parent = top.name
+            self.trace_id = top.trace_id
+            self.parent_span_id = top.span_id
+        else:
+            self.trace_id = _new_trace_id()
+        self.span_id = _new_span_id()
         stack.append(self)
         self._t0 = time.perf_counter()
         return self
@@ -120,6 +245,9 @@ class Tracer:
         self._path: Optional[str] = None  # guarded-by: none(racy hot-path read)
         self._recording = False  # guarded-by: none(racy hot-path read)
         self._observer: Optional[Callable[[str, Dict[str, Any], float], None]] = None  # guarded-by: none(racy hot-path read)
+        # flight-recorder sink: (kind, name, args, ts_s, dur_s) for every
+        # completed span / instant, read racily like _observer
+        self._flight: Optional[Callable[[str, str, Dict[str, Any], float, float], None]] = None  # guarded-by: none(racy hot-path read)
         self._epoch = time.perf_counter()
         self._pid = os.getpid()
         self._thread_names: Dict[int, str] = {}  # guarded-by: _lock
@@ -169,23 +297,80 @@ class Tracer:
         with self._lock:
             self._observer = observer
 
+    def set_flight_sink(
+        self,
+        sink: Optional[Callable[[str, str, Dict[str, Any], float, float], None]],
+    ) -> None:
+        """Single flight-recorder slot (libs/flightrec installs itself
+        here): called with (kind, name, args, ts_seconds, dur_seconds)
+        for every completed span and instant, even in ``off`` mode, so
+        the post-mortem ring stays warm when the trace ring is not."""
+        with self._lock:
+            self._flight = sink
+
     # --- recording -----------------------------------------------------------
 
-    def _stack(self) -> List[_Span]:
+    def _stack(self) -> List[Any]:
         stack = getattr(self._tls, "stack", None)
         if stack is None:
             stack = self._tls.stack = []
         return stack
 
-    def span(self, name: str, **args: Any) -> Any:
+    def span(
+        self,
+        name: str,
+        parent_ctx: Optional[TraceContext] = None,
+        **args: Any,
+    ) -> Any:
         """``with tracer.span("prep_chunk", lane_count=n):`` — nested
-        spans inherit this one as parent (per-thread)."""
-        if not self._recording and self._observer is None:
+        spans inherit this one as parent (per-thread). ``parent_ctx``
+        splices the span under a remote caller's context instead."""
+        if not self._recording and self._observer is None and self._flight is None:
             return NOP_SPAN
-        return _Span(self, name, args)
+        return _Span(self, name, args, remote=parent_ctx)
+
+    @contextmanager
+    def attach(self, ctx: Optional[TraceContext]):
+        """Make ``ctx`` the parent of every span this thread opens
+        inside the block (no-op when ``ctx`` is None)."""
+        if ctx is None or not self._recording:
+            yield None
+            return
+        stack = self._stack()
+        anchor = _RemoteAnchor(ctx)
+        stack.append(anchor)
+        try:
+            yield anchor
+        finally:
+            if stack and stack[-1] is anchor:
+                stack.pop()
+            elif anchor in stack:
+                stack.remove(anchor)
+
+    def current_context(self) -> Optional[TraceContext]:
+        """Context of this thread's innermost active span (None when no
+        span is open or the tracer is not recording)."""
+        if not self._recording:
+            return None
+        stack = self._stack()
+        if not stack:
+            return None
+        top = stack[-1]
+        if not top.trace_id:
+            return None
+        return TraceContext(top.trace_id, top.span_id, 1)
 
     def instant(self, name: str, **args: Any) -> None:
         """Zero-duration event (device health transitions etc.)."""
+        flight = self._flight
+        if flight is None and not self._recording:
+            return
+        now = time.perf_counter()
+        if flight is not None:
+            try:
+                flight("instant", name, args, now, 0.0)
+            except Exception:
+                pass  # the post-mortem ring must not fail the op
         if not self._recording:
             return
         ev = {
@@ -194,9 +379,13 @@ class Tracer:
             "s": "p",
             "pid": self._pid,
             "tid": threading.get_ident(),
-            "ts": round((time.perf_counter() - self._epoch) * 1e6, 3),
+            "ts": round((now - self._epoch) * 1e6, 3),
             "args": args,
         }
+        stack = self._stack()
+        if stack and stack[-1].trace_id:
+            ev["trace_id"] = stack[-1].trace_id
+            ev["parent_span_id"] = stack[-1].span_id
         self._append(ev)
 
     def _complete(self, span: _Span, t1: float) -> None:
@@ -207,6 +396,12 @@ class Tracer:
                 observer(span.name, span.args, duration)
             except Exception:
                 pass  # a broken metrics binding must not fail the traced op
+        flight = self._flight
+        if flight is not None:
+            try:
+                flight("span", span.name, span.args, span._t0, duration)
+            except Exception:
+                pass  # the post-mortem ring must not fail the traced op
         if not self._recording:
             return
         args = span.args
@@ -221,6 +416,11 @@ class Tracer:
             "dur": round(duration * 1e6, 3),
             "args": args,
         }
+        if span.trace_id:
+            ev["trace_id"] = span.trace_id
+            ev["span_id"] = span.span_id
+            if span.parent_span_id:
+                ev["parent_span_id"] = span.parent_span_id
         self._append(ev)
 
     def _append(self, ev: Dict[str, Any]) -> None:
@@ -235,11 +435,17 @@ class Tracer:
 
     # --- export --------------------------------------------------------------
 
-    def export(
-        self, limit: Optional[int] = None, clear: bool = False
-    ) -> Dict[str, Any]:
-        """Chrome ``trace_events`` JSON object; ``limit`` keeps the most
-        recent N events (the response stays bounded)."""
+    def _epoch_unix_us(self) -> float:
+        """Wall-clock instant (unix microseconds) of the perf-counter
+        epoch every event ``ts`` is relative to — the per-process anchor
+        scripts/trace_merge.py uses for clock-skew correction."""
+        return (time.time() - (time.perf_counter() - self._epoch)) * 1e6
+
+    def _snapshot(
+        self, limit: Optional[int], clear: bool
+    ) -> "tuple[List[Dict[str, Any]], List[Dict[str, Any]], Dict[str, Any]]":
+        """(meta_events, events, otherData) — the only part of an export
+        that runs under the tracer lock is the ring copy."""
         with self._lock:
             events = list(self._ring)
             recorded, dropped = self.recorded, self.dropped
@@ -259,15 +465,53 @@ class Tracer:
             }
             for tid, tname in sorted(names.items())
         ]
+        other = {
+            "mode": self._mode,
+            "recorded": recorded,
+            "dropped": dropped,
+            "pid": self._pid,
+            "epoch_unix_us": round(self._epoch_unix_us(), 1),
+        }
+        return meta, events, other
+
+    def export(
+        self, limit: Optional[int] = None, clear: bool = False
+    ) -> Dict[str, Any]:
+        """Chrome ``trace_events`` JSON object; ``limit`` keeps the most
+        recent N events (the response stays bounded)."""
+        meta, events, other = self._snapshot(limit, clear)
         return {
             "traceEvents": meta + events,
             "displayTimeUnit": "ms",
-            "otherData": {
-                "mode": self._mode,
-                "recorded": recorded,
-                "dropped": dropped,
-            },
+            "otherData": other,
         }
+
+    def export_chunks(
+        self,
+        limit: Optional[int] = None,
+        clear: bool = False,
+        fmt: str = "full",
+    ) -> Iterator[bytes]:
+        """Streamed export: the tracer lock is held only for the ring
+        snapshot (O(events) pointer copies); all JSON serialization
+        happens outside it, yielded in bounded chunks. ``fmt="chrome"``
+        emits a pure Chrome/Perfetto document (no ``otherData``)."""
+        meta, events, other = self._snapshot(limit, clear)
+        yield b'{"traceEvents": ['
+        first = True
+        batch: List[str] = []
+        for ev in meta + events:
+            batch.append(("" if first else ",") + json.dumps(ev))
+            first = False
+            if len(batch) >= 256:
+                yield "".join(batch).encode()
+                batch = []
+        if batch:
+            yield "".join(batch).encode()
+        tail = '], "displayTimeUnit": "ms"'
+        if fmt != "chrome":
+            tail += ', "otherData": %s' % json.dumps(other)
+        yield (tail + "}").encode()
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         """Per-stage p50/p95/total over the ring's completed spans,
@@ -345,9 +589,20 @@ def configure(mode: Optional[str] = None) -> Tracer:
     return tracer.configure(mode)
 
 
-def span(name: str, **args: Any) -> Any:
-    return tracer.span(name, **args)
+def span(
+    name: str, parent_ctx: Optional[TraceContext] = None, **args: Any
+) -> Any:
+    return tracer.span(name, parent_ctx=parent_ctx, **args)
 
 
 def instant(name: str, **args: Any) -> None:
     tracer.instant(name, **args)
+
+
+def attach(ctx: Optional[TraceContext]):
+    """``with tracing.attach(ctx): ...`` — remote-parent splice."""
+    return tracer.attach(ctx)
+
+
+def current_context() -> Optional[TraceContext]:
+    return tracer.current_context()

@@ -31,6 +31,15 @@ class HTTPClient:
             "method": method,
             "params": params or {},
         }
+        # cross-process trace propagation: when this thread is inside a
+        # recorded span, ride its context as the optional "trace" member
+        # so the server's handler spans link under ours in a merged
+        # fleet timeline (rpc/server._dispatch attaches it)
+        from tendermint_tpu.libs import tracing
+
+        ctx = tracing.current_context()
+        if ctx is not None:
+            req["trace"] = ctx.to_header()
         data = json.dumps(req).encode()
         http_req = urllib.request.Request(
             self.url,

@@ -422,7 +422,11 @@ class RPCServer:
         if method == "debug/traces":
             # Chrome-trace JSON export of the global span tracer; bounded
             # by the tracer's ring capacity. ?limit=N caps the event
-            # count, ?clear=1 drains the ring after read.
+            # count, ?clear=1 drains the ring after read, ?format=chrome
+            # yields the bare trace_events shape trace_merge consumes.
+            # The tracer lock is held only for the ring snapshot; JSON
+            # serialization streams in bounded chunks OUTSIDE it, so a
+            # big ring can't stall every traced hot path mid-dump.
             from tendermint_tpu.libs import tracing
 
             q = dict(parse_qsl(parsed.query))
@@ -431,9 +435,12 @@ class RPCServer:
             except ValueError:
                 limit = None
             clear = q.get("clear") in ("1", "true")
-            body = json.dumps(
-                tracing.tracer.export(limit=limit, clear=clear)
-            ).encode()
+            fmt = "chrome" if q.get("format") == "chrome" else "full"
+            body = b"".join(
+                tracing.tracer.export_chunks(
+                    limit=limit, clear=clear, fmt=fmt
+                )
+            )
             return 200, "application/json", body
         if method == "metrics" and self.metrics_registry is not None:
             return (
@@ -477,13 +484,26 @@ class RPCServer:
             }
             return resp
         params = req.get("params") or {}
+        # optional cross-process trace context: a caller that is itself
+        # traced (lightd, bench drivers) adds a top-level "trace" member
+        # ("<trace_id>-<span_id>-<flags>"); every span this handler
+        # opens then links under the caller's span in the merged fleet
+        # timeline. Absent/malformed members change nothing.
+        from tendermint_tpu.libs import tracing
+
+        raw_trace = req.get("trace")
+        ctx = (
+            tracing.TraceContext.from_header(raw_trace)
+            if isinstance(raw_trace, str)
+            else None
+        )
         try:
-            if isinstance(params, dict):
-                result = fn(**params)
-            elif isinstance(params, list):
-                result = fn(*params)
-            else:
-                raise RPCError(INVALID_PARAMS, "params must be object or array")
+            with tracing.attach(ctx):
+                if ctx is not None:
+                    with tracing.span("rpc_dispatch", method=method or ""):
+                        result = _invoke(fn, params)
+                else:
+                    result = _invoke(fn, params)
             resp["result"] = result
         except RPCError as e:
             resp["error"] = {"code": e.code, "message": e.message, "data": e.data}
@@ -501,6 +521,14 @@ class RPCServer:
         lines = ["Available endpoints:"]
         lines += sorted(f"  /{name}" for name in self.routes)
         return "\n".join(lines)
+
+
+def _invoke(fn: Callable, params: Any) -> Any:
+    if isinstance(params, dict):
+        return fn(**params)
+    if isinstance(params, list):
+        return fn(*params)
+    raise RPCError(INVALID_PARAMS, "params must be object or array")
 
 
 def _error_envelope(code: int, message: str, data: str = "") -> Dict[str, Any]:

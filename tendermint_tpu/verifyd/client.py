@@ -45,6 +45,7 @@ from typing import Callable, List, Optional, Sequence
 
 from tendermint_tpu.libs import tracing
 from tendermint_tpu.libs.grpc import GrpcChannel, GrpcError, H2ProtocolError
+from tendermint_tpu.libs.metrics import VerifydMetrics
 from tendermint_tpu.verifyd import protocol
 from tendermint_tpu.verifyd import shm as shm_transport
 from tendermint_tpu.verifyd.protocol import (
@@ -146,6 +147,7 @@ class VerifydClient:
         shed_retries: int = 2,
         shed_backoff: float = 0.02,
         shm: Optional[str] = None,
+        metrics: Optional[VerifydMetrics] = None,
     ):
         host, _, port = addr.rpartition(":")
         if not host or not port.isdigit():
@@ -169,11 +171,16 @@ class VerifydClient:
         self._pool_size = max(1, pool_size)
         self._available = threading.Condition(self._mtx)
         # observability
+        self.metrics = metrics or VerifydMetrics.nop()
         self.calls = 0
         self.transport_retries = 0
         self.fallback_calls = 0
         self.shed_retries_used = 0
         self.rejected = {}  # status -> count
+        # end-to-end latency attribution: cumulative per-stage seconds
+        # from server stage vectors (metrics-free view for bench/tests)
+        self.stage_totals: dict = {}
+        self.stage_calls = 0
         # zero-copy ingress: negotiated lazily when the server shares
         # this host and advertises an endpoint (TENDERMINT_TPU_SHM /
         # [ops] verify_shm / the shm param; off restores pure TCP)
@@ -218,6 +225,8 @@ class VerifydClient:
             "transport_retries": self.transport_retries,
             "fallback_calls": self.fallback_calls,
             "shed_retries_used": self.shed_retries_used,
+            "stage_totals": dict(self.stage_totals),
+            "stage_calls": self.stage_calls,
             **shm_stats,
         }
 
@@ -331,6 +340,7 @@ class VerifydClient:
         # that fell back split here and merge their verdicts
         verdicts: List[bool] = []
         depth = 0
+        stage_acc: dict = {}
         for start in range(0, len(req), protocol.MAX_LANES):
             end = start + protocol.MAX_LANES
             sub = VerifyRequest(
@@ -342,14 +352,18 @@ class VerifydClient:
                 msgs=list(req.msgs[start:end]),
                 sigs=list(req.sigs[start:end]),
                 tenant=req.tenant,
+                trace=req.trace,  # every split rides the same trace
             )
             resp = self.call(sub, timeout=timeout)
             if resp.status != STATUS_OK:
                 return resp
             verdicts.extend(resp.verdicts)
             depth = max(depth, resp.queue_depth)
+            for stage, v in protocol.unpack_stages(resp.stages).items():
+                stage_acc[stage] = stage_acc.get(stage, 0.0) + v
         return protocol.VerifyResponse(
-            status=STATUS_OK, verdicts=verdicts, queue_depth=depth
+            status=STATUS_OK, verdicts=verdicts, queue_depth=depth,
+            stages=protocol.pack_stages(stage_acc) if stage_acc else b"",
         )
 
     # --- calls --------------------------------------------------------------
@@ -424,6 +438,11 @@ class VerifydClient:
         with tracing.span(
             "verifyd_call", lanes=len(pks), klass=klass, algo=algo
         ) as sp:
+            # propagate this span's context on the wire (protocol field
+            # 7) so the server's enqueue/dispatch/chunk spans link under
+            # it in the merged fleet timeline; empty when tracing is off
+            ctx = tracing.current_context()
+            trace_bytes = ctx.to_bytes() if ctx is not None else b""
             delay = self.shed_backoff
             sheds = 0
             while True:
@@ -445,6 +464,7 @@ class VerifydClient:
                     msgs=list(msgs),
                     sigs=list(sigs),
                     tenant=self.tenant,
+                    trace=trace_bytes,
                 )
                 try:
                     # transport grace past the verify deadline: the
@@ -489,7 +509,40 @@ class VerifydClient:
                 self.fallback_calls += 1
                 return _host_verify(algo, pks, msgs, sigs)
             sp.set(outcome="ok", sheds=sheds)
+            self._note_stages(resp, ctx, time.monotonic() - t0)
             return list(resp.verdicts)
+
+    def _note_stages(
+        self,
+        resp: protocol.VerifyResponse,
+        ctx: Optional[tracing.TraceContext],
+        wall_s: float,
+    ) -> None:
+        """End-to-end latency attribution: fold the server's stage-time
+        vector into the ``e2e_stage_seconds{stage}`` histograms, with
+        the request's trace id attached as an OpenMetrics exemplar so a
+        latency outlier links straight into the merged fleet timeline.
+        The unattributed remainder (client wall minus stage sum) is the
+        transport overhead and rides the ``transport`` pseudo-stage."""
+        if not resp.stages:
+            return
+        stages = protocol.unpack_stages(resp.stages)
+        exem = {"trace_id": ctx.trace_id} if ctx is not None else None
+        attributed = 0.0
+        for stage, v in stages.items():
+            attributed += v
+            self.metrics.e2e_stage_seconds.labels(stage=stage).observe(
+                v, exemplar=exem
+            )
+            self.stage_totals[stage] = self.stage_totals.get(stage, 0.0) + v
+        overhead = max(0.0, wall_s - attributed)
+        self.metrics.e2e_stage_seconds.labels(stage="transport").observe(
+            overhead, exemplar=exem
+        )
+        self.stage_totals["transport"] = (
+            self.stage_totals.get("transport", 0.0) + overhead
+        )
+        self.stage_calls += 1
 
     @property
     def verify_fn(self) -> Callable[..., List[bool]]:

@@ -21,6 +21,13 @@ Request (proto wire form):
                           byte-identical to before the field existed,
                           and the decoder maps absence back to
                           DEFAULT_TENANT)
+    7  trace     bytes    compact trace context (libs/tracing.
+                          TraceContext.to_bytes(): 8B trace_id + 8B
+                          span_id + 1B flags); OMITTED when the caller
+                          has no active trace, so an untraced client
+                          emits frames byte-identical to before the
+                          field existed and the decoder maps absence
+                          back to the empty (no-trace) default
 
 Response:
     1  status       varint   OK | RESOURCE_EXHAUSTED | DEADLINE_EXCEEDED
@@ -29,6 +36,10 @@ Response:
     3  message      string   human-readable detail on non-OK
     4  queue_depth  varint   server pending depth at respond time
                              (client-side load hint)
+    5  stages       bytes    stage-time vector (pack_stages: one f32 of
+                             seconds per STAGE_NAMES entry, in order);
+                             OMITTED when the server recorded none, so
+                             old servers' frames are byte-identical
 
 ``kind`` is advisory: commit semantics (tallying, sign-bytes
 construction) stay on the client; the server sees only raw lanes, so
@@ -38,8 +49,9 @@ metrics and picks the default class when the caller sets none.
 
 from __future__ import annotations
 
+import struct
 from dataclasses import dataclass, field
-from typing import List
+from typing import Dict, List
 
 from tendermint_tpu.encoding.proto import (
     WIRE_BYTES,
@@ -104,6 +116,33 @@ MAX_MSG_SIZE = 1 << 20  # 1 MiB per lane message
 DEFAULT_TENANT = "default"
 MAX_TENANT_LEN = 64  # wire-level cap; the server additionally hashes/caps
 
+# trace context: pre-trace clients never send field 7, so the decoder
+# must map absence to the empty (no-trace) default — and the encoder
+# must OMIT it when empty, the same zero-omission symmetry as tenant.
+MAX_TRACE_LEN = 64  # wire-level cap; today's context is 17 bytes
+
+# End-to-end latency attribution stage vector (response field 5), in
+# wire order. Each stage is one f32 of seconds summed from the server's
+# real spans; together they account for the server-side request wall.
+STAGE_NAMES = ("wire_wait", "admission", "batch_residency", "device", "collect")
+_STAGES_STRUCT = struct.Struct("<%df" % len(STAGE_NAMES))
+
+
+def pack_stages(stages: Dict[str, float]) -> bytes:
+    """Stage dict -> wire vector (missing stages pack as 0.0)."""
+    return _STAGES_STRUCT.pack(
+        *(max(0.0, float(stages.get(name, 0.0))) for name in STAGE_NAMES)
+    )
+
+
+def unpack_stages(raw: bytes) -> Dict[str, float]:
+    """Wire vector -> stage dict; empty/short input yields {} (an old
+    server that never sent field 5)."""
+    if len(raw) < _STAGES_STRUCT.size:
+        return {}
+    vals = _STAGES_STRUCT.unpack_from(raw)
+    return dict(zip(STAGE_NAMES, vals))
+
 
 @dataclass
 class VerifyRequest:
@@ -115,6 +154,7 @@ class VerifyRequest:
     msgs: List[bytes] = field(default_factory=list)
     sigs: List[bytes] = field(default_factory=list)
     tenant: str = DEFAULT_TENANT
+    trace: bytes = b""
 
     def __len__(self) -> int:
         return len(self.pks)
@@ -126,6 +166,7 @@ class VerifyResponse:
     verdicts: List[bool] = field(default_factory=list)
     message: str = ""
     queue_depth: int = 0
+    stages: bytes = b""
 
 
 def _encode_lane(pk: bytes, msg: bytes, sig: bytes) -> bytes:
@@ -152,6 +193,8 @@ def encode_request(req: VerifyRequest) -> bytes:
         out += encode_bytes_field(5, _encode_lane(pk, msg, sig))
     if req.tenant and req.tenant != DEFAULT_TENANT:
         out += encode_string_field(6, req.tenant)
+    if req.trace:
+        out += encode_bytes_field(7, req.trace)
     return bytes(out)
 
 
@@ -186,6 +229,8 @@ def encoded_request_size(req: VerifyRequest) -> int:
     if req.tenant and req.tenant != DEFAULT_TENANT:
         tenant = req.tenant.encode("utf-8")
         size += 1 + _varint_size(len(tenant)) + len(tenant)
+    if req.trace:
+        size += 1 + _varint_size(len(req.trace)) + len(req.trace)
     return size
 
 
@@ -223,6 +268,8 @@ def decode_request(data: bytes) -> VerifyRequest:
                 req.sigs.append(sig)
             elif fld == 6 and wire == WIRE_BYTES:
                 req.tenant = r.read_bytes().decode("utf-8", "replace")
+            elif fld == 7 and wire == WIRE_BYTES:
+                req.trace = r.read_bytes()
             else:
                 r.skip(wire)
     except ValueError:
@@ -232,8 +279,13 @@ def decode_request(data: bytes) -> VerifyRequest:
     # absence (old client) and the empty string both mean the default
     # tenant — re-establishing the encoder's omitted constant (TPW004)
     req.tenant = req.tenant or DEFAULT_TENANT
+    # absence (pre-trace client) means no trace context — re-establish
+    # the encoder's omitted empty default the same way (TPW004)
+    req.trace = req.trace or b""
     if len(req.tenant) > MAX_TENANT_LEN:
         raise ValueError(f"tenant name too long: {len(req.tenant)}")
+    if len(req.trace) > MAX_TRACE_LEN:
+        raise ValueError(f"trace context too long: {len(req.trace)}")
     if req.kind not in KIND_NAMES:
         raise ValueError(f"unknown kind {req.kind}")
     if req.klass not in CLASS_NAMES:
@@ -264,6 +316,8 @@ def encode_response(resp: VerifyResponse) -> bytes:
         out += encode_string_field(3, resp.message)
     if resp.queue_depth:
         out += encode_varint_field(4, resp.queue_depth)
+    if resp.stages:
+        out += encode_bytes_field(5, resp.stages)
     return bytes(out)
 
 
@@ -280,10 +334,14 @@ def decode_response(data: bytes) -> VerifyResponse:
                 resp.message = r.read_bytes().decode("utf-8", "replace")
             elif fld == 4 and wire == WIRE_VARINT:
                 resp.queue_depth = r.read_varint()
+            elif fld == 5 and wire == WIRE_BYTES:
+                resp.stages = r.read_bytes()
             else:
                 r.skip(wire)
     except Exception as exc:
         raise ValueError(f"malformed response: {exc}") from exc
+    # absence (old server) means no stage vector (TPW004 symmetry)
+    resp.stages = resp.stages or b""
     if resp.status not in STATUS_NAMES:
         raise ValueError(f"unknown status {resp.status}")
     return resp

@@ -678,6 +678,7 @@ class VerifydServer:
         kind_name: str,
         queue_depth: int = 0,
         tenant_label: str = "",
+        stages: Optional[Dict[str, float]] = None,
     ) -> protocol.VerifyResponse:
         with tracing.span("verifyd_respond", status=STATUS_NAMES[status]):
             with self._stats_mtx:
@@ -697,6 +698,7 @@ class VerifydServer:
                 verdicts=verdicts,
                 message=message,
                 queue_depth=queue_depth,
+                stages=protocol.pack_stages(stages) if stages else b"",
             )
 
     def _shed(
@@ -755,10 +757,12 @@ class VerifydServer:
         msgs = [
             m.tobytes() if type(m) is memoryview else m for m in req.msgs
         ]
+        t_dev0 = time.monotonic()
         with tracing.span(
             "verifyd_host_direct", lanes=n, tenant=ts.label, level=level
         ):
             verdicts = list(host_fn(req.pks, msgs, req.sigs))
+        t_dev1 = time.monotonic()
         with self._stats_mtx:
             self.host_direct_lanes += n
         with self._tenant_mtx:
@@ -767,7 +771,12 @@ class VerifydServer:
         self.metrics.host_direct_lanes.inc(n)
         self.metrics.tenant_lanes.labels(tenant=ts.label).inc(n)
         return self._respond(
-            STATUS_OK, verdicts, "", t0, kind_name, 0, tenant_label=ts.label
+            STATUS_OK, verdicts, "", t0, kind_name, 0, tenant_label=ts.label,
+            stages={
+                "admission": t_dev0 - t0,
+                "device": t_dev1 - t_dev0,
+                "collect": time.monotonic() - t_dev1,
+            },
         )
 
     def _handle(self, payload: bytes) -> bytes:
@@ -800,8 +809,31 @@ class VerifydServer:
         tenant budgets, enqueue, wait. ``on_entries`` (shm drain) gets
         the scheduler entries right after submit so the caller can tell
         whether a deadline response left lanes still holding slab
-        memoryviews (the held-slab reclaim protocol)."""
+        memoryviews (the held-slab reclaim protocol).
+
+        When the request carries a trace context (protocol field 7 /
+        slab header trace words) every span this handler opens links
+        under the CLIENT's span, so a fleet-merged timeline shows the
+        client's ``verifyd_call`` as ancestor of the server's enqueue,
+        dispatch, and chunk spans."""
+        ctx = (
+            tracing.TraceContext.from_bytes(req.trace) if req.trace else None
+        )
+        if ctx is None:
+            return self._serve_inner(req, t0, tag, on_entries, None)
+        with tracing.attach(ctx):
+            return self._serve_inner(req, t0, tag, on_entries, ctx)
+
+    def _serve_inner(
+        self,
+        req: protocol.VerifyRequest,
+        t0: float,
+        tag: Optional[object],
+        on_entries: Optional[Callable[[List[object]], None]],
+        ctx: Optional[tracing.TraceContext],
+    ) -> protocol.VerifyResponse:
         kind_name = "raw"
+        t_entry = time.monotonic()  # decode/transport hand-off boundary
         try:
             kind_name = KIND_NAMES[req.kind]
             klass_name = CLASS_NAMES[req.klass]
@@ -903,12 +935,18 @@ class VerifydServer:
                         flush_by=flush_by,
                         tag=tag,
                         tenant=ts.label,
+                        # inside the enqueue span the current context IS
+                        # the enqueue span (deepest linkage); when tracing
+                        # is off locally, propagate the client's context
+                        # so coalesced waiters still link in the merge
+                        trace=tracing.current_context() or ctx,
                     )
             except SchedulerSaturatedError as exc:
                 return self._shed(
                     ts, klass_name, "saturated", n,
                     str(exc), t0, kind_name, sched.pending_depth(),
                 )
+            t_submit = time.monotonic()
             self._track_depth(req.klass, n)
             self._tenant_admit(ts, n)
             self.metrics.lanes.labels(klass=klass_name).inc(n)
@@ -942,9 +980,26 @@ class VerifydServer:
             finally:
                 self._track_depth(req.klass, -n)
                 self._tenant_release(ts, n)
+            # latency attribution: the stage vector tiles the full
+            # server wall t0 -> now with REAL span boundaries, so the
+            # client can see where its round trip went (any gap between
+            # the client's observed wall and this sum is transport).
+            disp = [e.t_dispatch for e in entries if e.t_dispatch > 0.0]
+            fin = [e.t_done for e in entries if e.t_done > 0.0]
+            t_disp = min(disp) if disp else t_submit
+            t_fin = max(fin) if fin else t_disp
+            now = time.monotonic()
+            stages = {
+                "wire_wait": t_entry - t0,
+                "admission": t_submit - t_entry,
+                "batch_residency": t_disp - t_submit,
+                "device": t_fin - t_disp,
+                "collect": now - t_fin,
+            }
             return self._respond(
                 STATUS_OK, verdicts, "", t0, kind_name,
                 sched.pending_depth(), tenant_label=ts.label,
+                stages=stages,
             )
         except Exception as exc:  # never tear the stream on a handler bug
             return self._respond(

@@ -78,6 +78,7 @@ import time
 from multiprocessing import resource_tracker, shared_memory
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from tendermint_tpu.libs import tracing
 from tendermint_tpu.libs.evloop import EvloopMetrics, EvloopServer
 from tendermint_tpu.libs.sanitizer import instrument_attrs
 from tendermint_tpu.verifyd import protocol
@@ -96,7 +97,7 @@ from tendermint_tpu.verifyd.protocol import (
 )
 
 SHM_ENV = "TENDERMINT_TPU_SHM"
-SHM_VERSION = 1
+SHM_VERSION = 2  # v2: trace-context header words + stage vector on RESP
 SHM_MAGIC = 0x54_4D_54_50_55_53_4C_42  # "TMTPUSLB"
 
 # per-request lane cap on the slab path; one 2 MiB slab holds an
@@ -130,8 +131,14 @@ SLAB_OFF_ALGO = 16  # u32
 SLAB_OFF_LANES = 20  # u32
 SLAB_OFF_TENANT_LEN = 24  # u32, 0 = DEFAULT_TENANT (zero-omission)
 SLAB_OFF_TENANT = 28  # MAX_TENANT_LEN bytes, utf-8, zero-padded
-SLAB_OFF_GEN2 = 92  # u32 trailing seqlock stamp
-SLAB_HEADER_BYTES = 96
+SLAB_OFF_TRACE = 92  # TraceContext wire form (17B), all-zero = absent
+SLAB_OFF_GEN2 = 112  # u32 trailing seqlock stamp
+SLAB_HEADER_BYTES = 116
+
+# the fixed trace-context wire form (tracing.CTX_WIRE_LEN): 8B trace
+# id, 8B span id, 1B flags — stored verbatim so the drain path hands
+# protocol.decode-identical bytes to the serve path
+_TRACE_WIRE_LEN = tracing.CTX_WIRE_LEN
 
 _LANE_FIXED = PUBKEY_SIZE + SIG_SIZE
 
@@ -144,7 +151,9 @@ MSG_RESP = 5
 MSG_FREE = 6
 _FRAME_HDR = struct.Struct("<IB")
 _COMMIT_BODY = struct.Struct("<QII")  # seq, slot, lanes
-_RESP_HEAD = struct.Struct("<QIBBIH")  # seq, slot, status, held, depth, msg_len
+_RESP_HEAD = struct.Struct(
+    "<QIBBIHB"
+)  # seq, slot, status, held, depth, msg_len, stages_len
 _FREE_BODY = struct.Struct("<QI")  # seq, slot
 _MAX_FRAME = 1 << 20
 
@@ -181,6 +190,7 @@ def pack_header(
     algo: int,
     lanes: int,
     tenant: str = DEFAULT_TENANT,
+    trace: bytes = b"",
 ) -> None:
     """Publish a slab header. The caller has already written the lane
     table + payload and stamped ``stamp_begin``; this writes every
@@ -200,6 +210,14 @@ def pack_header(
         buf[base + SLAB_OFF_TENANT : base + SLAB_OFF_TENANT + len(raw)] = raw
     else:
         struct.pack_into("<I", buf, base + SLAB_OFF_TENANT_LEN, 0)
+    # trace context is written (or zeroed) unconditionally: slabs are
+    # reused, so an absent context must overwrite the previous
+    # generation's bytes — all-zero trace id decodes as "no trace",
+    # the same zero-omission default an omitted proto3 field yields
+    raw_trace = (trace or b"")[:_TRACE_WIRE_LEN].ljust(_TRACE_WIRE_LEN, b"\x00")
+    buf[base + SLAB_OFF_TRACE : base + SLAB_OFF_TRACE + _TRACE_WIRE_LEN] = (
+        raw_trace
+    )
     # publication order matters: GEN2 first, GEN last — a reader that
     # sees GEN even must also see GEN2 agree, or the slab is torn
     struct.pack_into("<I", buf, base + SLAB_OFF_GEN2, gen)
@@ -217,6 +235,9 @@ def unpack_header(buf, base: int) -> dict:
     (algo,) = struct.unpack_from("<I", buf, base + SLAB_OFF_ALGO)
     (lanes,) = struct.unpack_from("<I", buf, base + SLAB_OFF_LANES)
     (tenant_len,) = struct.unpack_from("<I", buf, base + SLAB_OFF_TENANT_LEN)
+    raw_trace = bytes(
+        buf[base + SLAB_OFF_TRACE : base + SLAB_OFF_TRACE + _TRACE_WIRE_LEN]
+    )
     (gen2,) = struct.unpack_from("<I", buf, base + SLAB_OFF_GEN2)
     if gen % 2 == 1 or gen != gen2:
         raise ValueError(f"torn slab: generation {gen}/{gen2}")
@@ -246,6 +267,9 @@ def unpack_header(buf, base: int) -> dict:
         "algo": algo,
         "lanes": lanes,
         "tenant": tenant,
+        # all-zero trace id = absent (zeroed/old header): re-establish
+        # the same empty default decode_request applies
+        "trace": raw_trace if any(raw_trace[:8]) else b"",
     }
 
 
@@ -708,6 +732,7 @@ class _ShmSession:
             msgs=msgs,
             sigs=sigs,
             tenant=hdr["tenant"],
+            trace=hdr["trace"],
         )
         # lanes are now the scheduler's problem; they stop counting as
         # ring backlog the moment the serve path (admission included)
@@ -768,11 +793,13 @@ class _ShmSession:
     def _respond(self, seq, slot, resp: VerifyResponse, *, held: bool) -> None:
         msg = resp.message.encode("utf-8")[:0xFFFF]
         verdicts = bytes(1 if ok else 0 for ok in resp.verdicts)
+        stages = resp.stages[:0xFF]
         body = (
             _RESP_HEAD.pack(
                 seq, slot, resp.status, 1 if held else 0,
-                resp.queue_depth, len(msg),
+                resp.queue_depth, len(msg), len(stages),
             )
+            + stages
             + msg
             + verdicts
         )
@@ -1146,6 +1173,7 @@ class ShmClientTransport:
             algo=req.algo,
             lanes=len(req),
             tenant=req.tenant,
+            trace=req.trace,
         )
 
     def _send_commit(self, seq: int, slot: int, lanes: int) -> None:
@@ -1185,10 +1213,12 @@ class ShmClientTransport:
                 )
                 body = _recv_exact(sock, length) if length else b""
                 if typ == MSG_RESP:
-                    seq, _slot, status, _held, depth, mlen = _RESP_HEAD.unpack_from(
-                        body, 0
-                    )
+                    (
+                        seq, _slot, status, _held, depth, mlen, slen,
+                    ) = _RESP_HEAD.unpack_from(body, 0)
                     off = _RESP_HEAD.size
+                    stages = bytes(body[off : off + slen])
+                    off += slen
                     message = body[off : off + mlen].decode("utf-8", "replace")
                     verdicts = [b == 1 for b in body[off + mlen :]]
                     resp = VerifyResponse(
@@ -1196,6 +1226,7 @@ class ShmClientTransport:
                         verdicts=verdicts,
                         message=message,
                         queue_depth=depth,
+                        stages=stages,
                     )
                     with self._cv:
                         # drop responses nobody awaits any more (the

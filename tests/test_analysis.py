@@ -639,9 +639,55 @@ class TestWireCompat:
         """
         assert self.run(src) == []
 
-    def test_truthiness_only_string_omission_passes(self):
-        # omit-when-empty round-trips (decode default IS ""): not TPW004
+    def test_truthiness_omitted_bytes_without_reestablish(self):
+        # the trace-context pattern: a bytes field omitted when falsy must
+        # have a decode path that pins the empty default, otherwise an old
+        # frame (field absent) decodes to None and re-encodes differently
         src = """
+            def encode_bytes_field(field, b):
+                return b""
+
+            def encode(req):
+                out = b""
+                if req.trace:
+                    out += encode_bytes_field(7, req.trace)
+                return out
+
+            def decode(r, req):
+                req.trace = r.read_bytes()
+                return req
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPW004"]
+        assert "trace" in found[0].message
+        assert "truthiness" in found[0].message
+
+    def test_truthiness_omitted_bytes_with_or_empty_passes(self):
+        # clean twin: post-parse `or b""` re-establishes the empty default,
+        # so absent-field frames decode byte-identically on re-encode
+        src = """
+            def encode_bytes_field(field, b):
+                return b""
+
+            def encode(req):
+                out = b""
+                if req.trace:
+                    out += encode_bytes_field(7, req.trace)
+                return out
+
+            def decode(r, req):
+                req.trace = r.read_bytes()
+                req.trace = req.trace or b""
+                return req
+        """
+        assert self.run(src) == []
+
+    def test_truthiness_omitted_string_with_dataclass_default_passes(self):
+        # an empty-literal dataclass default also pins the decode default
+        src = """
+            class VerifyResponse:
+                message: str = ""
+
             def encode_string_field(field, s):
                 return b""
 
@@ -880,6 +926,82 @@ class TestMetricsChecks:
             {METRICS_REL: metrics_src, "tendermint_tpu/ops/u.py": user_src},
         )
         assert codes(found) == ["TPM002"]
+
+    EXEMPLAR_METRICS = """
+        class M:
+            def __init__(self, reg):
+                self.lat = reg.histogram("tendermint_demo_lat", "h")
+                self.hits = reg.counter("tendermint_demo_hits", "h")
+    """
+
+    def test_exemplar_on_histogram_passes(self):
+        user_src = """
+            def f(m, tid):
+                m.hits.inc()
+                m.lat.labels(stage="device").observe(
+                    0.1, exemplar={"trace_id": tid}
+                )
+        """
+        found = run_on(
+            MetricsChecker(),
+            {
+                METRICS_REL: self.EXEMPLAR_METRICS,
+                "tendermint_tpu/ops/u.py": user_src,
+            },
+        )
+        assert found == []
+
+    def test_exemplar_on_undeclared_instrument(self):
+        # the reverse of TPM001: call site survives a declaration rename
+        user_src = """
+            def f(m, tid):
+                m.hits.inc()
+                m.lat.labels(stage="x").observe(0.1, exemplar=None)
+                m.lat_renamed.observe(0.1, exemplar={"trace_id": tid})
+        """
+        found = run_on(
+            MetricsChecker(),
+            {
+                METRICS_REL: self.EXEMPLAR_METRICS,
+                "tendermint_tpu/ops/u.py": user_src,
+            },
+        )
+        assert codes(found) == ["TPM003"]
+        assert "lat_renamed" in found[0].message
+
+    def test_exemplar_on_counter_flagged(self):
+        user_src = """
+            def f(m, tid):
+                m.lat.observe(0.1)
+                m.hits.observe(1.0, exemplar={"trace_id": tid})
+        """
+        found = run_on(
+            MetricsChecker(),
+            {
+                METRICS_REL: self.EXEMPLAR_METRICS,
+                "tendermint_tpu/ops/u.py": user_src,
+            },
+        )
+        assert codes(found) == ["TPM003"]
+        assert "counter" in found[0].message
+
+    def test_exemplar_on_local_alias_skipped(self):
+        # a bare-name base is not statically resolvable; stay quiet
+        user_src = """
+            def f(m, tid):
+                m.hits.inc()
+                m.lat.observe(0.0)
+                h = object()
+                h.observe(0.1, exemplar={"trace_id": tid})
+        """
+        found = run_on(
+            MetricsChecker(),
+            {
+                METRICS_REL: self.EXEMPLAR_METRICS,
+                "tendermint_tpu/ops/u.py": user_src,
+            },
+        )
+        assert found == []
 
 
 # --- framework mechanics -----------------------------------------------------

@@ -1,0 +1,315 @@
+"""Fault flight recorder (libs/flightrec.py): ring bounds, dump
+triggers, handler-chain installation, and the concurrency class the
+tpusan hb/explore CI stages target.
+
+The subprocess tests exercise the real fault paths (SIGTERM, unhandled
+exception) end to end — a dump written by a dying process is the whole
+point of the recorder, so those paths are not faked with direct calls.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.libs import flightrec, tracing
+from tendermint_tpu.libs.flightrec import (
+    KIND_INSTANT,
+    KIND_MARK,
+    KIND_METRIC,
+    KIND_SPAN,
+    FlightRecorder,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def rec(tmp_path, monkeypatch):
+    monkeypatch.setenv(flightrec.DIR_ENV, str(tmp_path))
+    monkeypatch.delenv(flightrec.ENABLE_ENV, raising=False)
+    return FlightRecorder(cap_bytes=8192, window_s=30.0)
+
+
+# --- ring mechanics ----------------------------------------------------------
+
+
+class TestRing:
+    def test_byte_bound_evicts_oldest(self, rec):
+        for i in range(500):
+            rec.record(KIND_MARK, "m%d" % i, {"pad": "x" * 100})
+        stats = rec.stats()
+        assert stats["bytes"] <= rec.cap_bytes
+        assert stats["evicted"] > 0
+        assert stats["recorded"] == 500
+        # the survivors are the NEWEST records
+        names = [r["name"] for r in rec.snapshot()]
+        assert names[-1] == "m499"
+        assert "m0" not in names
+
+    def test_payload_cap_truncates_not_raises(self, rec):
+        rec.record(KIND_MARK, "big", {"blob": "y" * 4096})
+        rows = rec.snapshot()
+        assert len(rows) == 1
+        # a truncated payload decodes to the sentinel, never raises
+        assert rows[0]["name"] in ("big", "<truncated>")
+
+    def test_unserializable_payload_keeps_name(self, rec):
+        rec.record(KIND_MARK, "odd", {"obj": object()})
+        assert rec.snapshot()[0]["name"] == "odd"
+
+    def test_window_filters_old_records(self, rec):
+        rec.record(KIND_MARK, "now", {})
+        assert rec.snapshot(window_s=3600) != []
+        assert rec.snapshot(window_s=1e-9) == []
+
+    def test_kind_decoding_and_duration(self, rec):
+        rec.record(KIND_SPAN, "s", {"a": 1}, dur_s=0.25)
+        rec.record(KIND_INSTANT, "i", {})
+        rec.record(KIND_METRIC, "m", {"v": 2.0})
+        rows = rec.snapshot()
+        assert [r["kind"] for r in rows] == ["span", "instant", "metric"]
+        assert rows[0]["dur_us"] == 250000
+        assert rows[0]["fields"] == {"a": 1}
+
+
+# --- dumps -------------------------------------------------------------------
+
+
+class TestDump:
+    def test_dump_writes_parseable_schema_doc(self, rec, tmp_path):
+        rec.mark("before_fault", step=7)
+        path = rec.dump("unit_test")
+        assert path is not None and os.path.exists(path)
+        doc = json.load(open(path))
+        assert doc["schema"] == flightrec.DUMP_SCHEMA
+        assert doc["reason"] == "unit_test"
+        assert doc["pid"] == os.getpid()
+        assert any(r["name"] == "before_fault" for r in doc["records"])
+        assert rec.last_dump_path() == path
+
+    def test_dump_budget_caps_disk_spam(self, rec):
+        paths = [rec.dump("spam%d" % i) for i in range(flightrec.MAX_DUMPS + 5)]
+        assert all(p is not None for p in paths[: flightrec.MAX_DUMPS])
+        assert all(p is None for p in paths[flightrec.MAX_DUMPS :])
+
+    def test_disabled_env_suppresses_dump(self, rec, monkeypatch):
+        monkeypatch.setenv(flightrec.ENABLE_ENV, "0")
+        assert rec.dump("nope") is None
+
+    def test_watchdog_instant_auto_dumps(self, rec):
+        rec.flight_sink(
+            "instant", "bench_watchdog_kill", {"section": "x"}, 0.0, 0.0
+        )
+        path = rec.last_dump_path()
+        assert path is not None
+        doc = json.load(open(path))
+        assert doc["reason"] == "watchdog_kill"
+        assert any(
+            r["name"] == "bench_watchdog_kill" for r in doc["records"]
+        )
+
+    def test_device_health_escalation_auto_dumps(self, rec):
+        rec.flight_sink(
+            "instant",
+            "device_health_transition",
+            {"to_state": "COOLDOWN"},
+            0.0,
+            0.0,
+        )
+        assert rec.last_dump_path() is not None
+        doc = json.load(open(rec.last_dump_path()))
+        assert doc["reason"] == "device_cooldown"
+
+    def test_healthy_transition_does_not_dump(self, rec):
+        rec.flight_sink(
+            "instant",
+            "device_health_transition",
+            {"to_state": "healthy"},
+            0.0,
+            0.0,
+        )
+        assert rec.last_dump_path() is None
+
+    def test_span_sink_records_without_dumping(self, rec):
+        rec.flight_sink("span", "bench_watchdog_kill_lookalike", {}, 0.0, 0.1)
+        rec.flight_sink("span", "bench_watchdog_kill", {}, 0.0, 0.1)
+        # spans never trigger (only instants are fault signals)
+        assert rec.last_dump_path() is None
+        assert len(rec) == 2
+
+
+# --- installation ------------------------------------------------------------
+
+
+class TestInstall:
+    def test_install_wires_tracer_sink(self, rec):
+        assert rec.install(signals=False)
+        try:
+            with tracing.tracer.span("flightrec_probe", n=1):
+                pass
+            tracing.instant("flightrec_probe_instant", k=2)
+            names = [r["name"] for r in rec.snapshot()]
+            assert "flightrec_probe" in names
+            assert "flightrec_probe_instant" in names
+        finally:
+            rec.uninstall()
+        # after uninstall the sink is detached
+        before = len(rec)
+        with tracing.tracer.span("flightrec_after", n=1):
+            pass
+        assert len(rec) == before
+
+    def test_install_is_idempotent(self, rec):
+        try:
+            assert rec.install(signals=False)
+            assert rec.install(signals=False)
+            assert rec.stats()["installed"]
+        finally:
+            rec.uninstall()
+        assert not rec.stats()["installed"]
+
+    def test_metric_sink_records_deltas(self, rec):
+        rec.metric_sink("tendermint_x_total", {"k": "v"}, 3.0)
+        row = rec.snapshot()[0]
+        assert row["kind"] == "metric"
+        assert row["fields"]["v"] == 3.0
+        assert row["fields"]["labels"] == {"k": "v"}
+
+    def test_sigterm_dump_from_real_process(self, tmp_path):
+        code = textwrap.dedent(
+            """
+            import os, signal, time
+            from tendermint_tpu.libs import flightrec
+            assert flightrec.install()
+            flightrec.mark("about_to_die", step=1)
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(10)  # unreachable: the chained handler re-kills
+            """
+        )
+        env = dict(os.environ)
+        env[flightrec.DIR_ENV] = str(tmp_path)
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env,
+            cwd=REPO,
+            capture_output=True,
+            timeout=60,
+        )
+        assert proc.returncode != 0  # died by signal, as intended
+        dumps = sorted(tmp_path.glob("flightrec-*-sigterm-*.json"))
+        assert len(dumps) == 1, list(tmp_path.iterdir())
+        doc = json.load(open(dumps[0]))
+        names = [r["name"] for r in doc["records"]]
+        assert "about_to_die" in names
+        assert "sigterm" in names
+
+    def test_unhandled_exception_dump_from_real_process(self, tmp_path):
+        code = textwrap.dedent(
+            """
+            from tendermint_tpu.libs import flightrec
+            assert flightrec.install()
+            flightrec.mark("last_good_step")
+            raise RuntimeError("injected crash")
+            """
+        )
+        env = dict(os.environ)
+        env[flightrec.DIR_ENV] = str(tmp_path)
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env,
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode != 0
+        assert "injected crash" in proc.stderr  # chained hook still ran
+        dumps = sorted(
+            tmp_path.glob("flightrec-*-unhandled_exception-*.json")
+        )
+        assert len(dumps) == 1, list(tmp_path.iterdir())
+        doc = json.load(open(dumps[0]))
+        names = [r["name"] for r in doc["records"]]
+        assert "last_good_step" in names
+        assert "unhandled_exception" in names
+
+
+# --- concurrency (tpusan hb + seeded-explore target) -------------------------
+
+
+class TestRingConcurrency:
+    """Producers hammer the ring while a reader snapshots and a dumper
+    dumps: every byte-accounting invariant must hold under any
+    interleaving (the CI explore stage replays this class under 10
+    deterministic schedules)."""
+
+    def test_concurrent_producers_keep_byte_invariant(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv(flightrec.DIR_ENV, str(tmp_path))
+        rec = FlightRecorder(cap_bytes=16384, window_s=30.0)
+        n_threads, per_thread = 4, 200
+        errors = []
+
+        def producer(t):
+            try:
+                for i in range(per_thread):
+                    rec.record(KIND_MARK, "t%d-%d" % (t, i), {"p": "z" * 40})
+            except Exception as exc:  # pragma: no cover - the assertion
+                errors.append(repr(exc))
+
+        def reader():
+            try:
+                for _ in range(20):
+                    rec.snapshot()
+                    rec.stats()
+                    time.sleep(0.001)
+            except Exception as exc:  # pragma: no cover
+                errors.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=producer, args=(t,))
+            for t in range(n_threads)
+        ] + [threading.Thread(target=reader)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert errors == []
+        stats = rec.stats()
+        assert stats["recorded"] == n_threads * per_thread
+        assert stats["bytes"] <= rec.cap_bytes
+        assert stats["recorded"] - stats["evicted"] == len(rec)
+
+    def test_concurrent_dump_and_record(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(flightrec.DIR_ENV, str(tmp_path))
+        rec = FlightRecorder(cap_bytes=16384, window_s=30.0)
+        stop = threading.Event()
+        errors = []
+
+        def producer():
+            i = 0
+            try:
+                while not stop.is_set():
+                    rec.record(KIND_MARK, "p%d" % i, {})
+                    i += 1
+            except Exception as exc:  # pragma: no cover
+                errors.append(repr(exc))
+
+        th = threading.Thread(target=producer)
+        th.start()
+        try:
+            paths = [rec.dump("concurrent%d" % i) for i in range(3)]
+        finally:
+            stop.set()
+            th.join()
+        assert errors == []
+        for p in paths:
+            assert p is not None
+            json.load(open(p))  # parseable mid-traffic

@@ -68,6 +68,7 @@ class TestSlabHeader:
             "gen": 4, "kind": protocol.KIND_COMMIT,
             "klass": protocol.CLASS_LIGHT, "deadline_ms": 250,
             "algo": protocol.ALGO_SR25519, "lanes": 17, "tenant": "chain-a",
+            "trace": b"",  # omitted context decodes to the empty default
         }
 
     def test_consensus_class_zero_survives(self):
