@@ -61,6 +61,16 @@ def child_main(name: str) -> int:
     with tracing.tracer.span("bench_section_body", section=name):
         fragment = section.fn(beat)
 
+    # Every fragment records the scheduler config it ran under (ISSUE
+    # 17): resolved knobs — mesh-aware batch default, env-resolved
+    # continuous/dyn-batch — not the static constants, so A/B artifacts
+    # stay attributable. Sections that measured a specific live
+    # scheduler (slo_replay) embed richer per-run knobs themselves.
+    if isinstance(fragment, dict):
+        from tendermint_tpu.crypto.scheduler import resolved_default_knobs
+
+        fragment.setdefault("scheduler_knobs", resolved_default_knobs())
+
     beat("done")
     print(json.dumps({"section": name, "fragment": fragment}), flush=True)
     return 0

@@ -32,7 +32,12 @@ makes that reasoning mechanical for ``verifyd/protocol.py`` and
   the same standard against the EMPTY default: the decode path must
   pin ``x.attr = x.attr or b""`` (or the dataclass default must be
   the empty literal), which is what proves an old frame without the
-  field decodes byte-identically to one that never carried it.
+  field decodes byte-identically to one that never carried it. The
+  same obligation applies to zero-omitted PLAIN varint fields emitted
+  via ``encode_varint_field`` (ISSUE 17's ``slo_ms`` is the canonical
+  case): when the field has no enum family, the decode path must pin
+  the integer zero (``x.attr = x.attr or 0`` or a zero dataclass
+  default) so an absent field decodes identically to an explicit 0.
 - TPW005 — slab-header codec asymmetry (``verifyd/shm.py``): the
   shared-memory slab header is a fixed layout named by ``SLAB_OFF_*``
   constants, and ``pack_header``/``unpack_header`` must both touch
@@ -56,8 +61,22 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 from scripts.analysis.core import Checker, Finding, Module, dotted_name, parent_map
 
 _WIRE_FILES = ("verifyd/protocol.py", "libs/grpc.py", "verifyd/shm.py")
-_EMIT_FNS = {"_put_varint", "_varint", "put_varint", "_tag", "_put_tag"}
+_EMIT_FNS = {
+    "_put_varint",
+    "_varint",
+    "put_varint",
+    "_tag",
+    "_put_tag",
+    # the proto3 field-level emitter the protocol codec actually uses
+    # (ISSUE 17: the slo_ms field rides it) — without this the TPW001
+    # zero-omission scan never saw the real encode sites
+    "encode_varint_field",
+}
 _STR_EMIT_FNS = {"encode_string_field", "encode_bytes_field"}
+# field-level varint emitters: zero-omission semantics live here, so
+# the TPW004 varint leg applies only to these, never to the raw varint
+# writers (HPACK indices, frame lengths) in _EMIT_FNS
+_VARINT_FIELD_EMIT_FNS = {"encode_varint_field"}
 
 
 class _EnumFamily:
@@ -104,6 +123,7 @@ class WireCompatChecker(Checker):
         yield from self._check_shift_symmetry(module, families)
         yield from self._check_grpc_status(module)
         yield from self._check_default_omission(module)
+        yield from self._check_varint_zero_omission(module, families)
         yield from self._check_slab_header_symmetry(module, consts)
 
     # --- enum discovery ------------------------------------------------------
@@ -175,7 +195,10 @@ class WireCompatChecker(Checker):
         """CONST name used as the decode-side default for ``attr``.
 
         Matches ``attr = SOME_CONST`` statements (the decoder's
-        pre-loop defaults) and ``Foo(..., attr or DEFAULT ...)`` calls.
+        pre-loop defaults) and dataclass field defaults
+        (``attr: int = SOME_CONST`` — the shape the protocol
+        dataclasses use, which IS the decode default because the
+        decoder mutates a default-constructed instance).
         """
         for node in ast.walk(module.tree):
             if isinstance(node, ast.Assign):
@@ -183,6 +206,13 @@ class WireCompatChecker(Checker):
                     if isinstance(t, ast.Name) and t.id == attr:
                         if isinstance(node.value, ast.Name):
                             return node.value.id
+            if (
+                isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == attr
+                and isinstance(node.value, ast.Name)
+            ):
+                return node.value.id
         return None
 
     def _check_zero_omission(
@@ -499,6 +529,88 @@ class WireCompatChecker(Checker):
             ):
                 return True
         return False
+
+    def _reestablishes_zero(self, module: Module, attr: str) -> bool:
+        """Does a decode path (or the dataclass default) pin ``attr``
+        to the integer zero an omitted varint field must decode as?
+
+        Accepted shapes mirror ``_reestablishes_empty``:
+        ``x.attr = x.attr or 0`` post-parse normalization, ``attr = 0``
+        pre-loop local, or a dataclass ``attr: int = 0`` default.
+        """
+
+        def zero_const(v: ast.AST) -> bool:
+            return (
+                isinstance(v, ast.Constant)
+                and v.value == 0
+                and v.value is not False
+            )
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                targets_attr = any(
+                    (isinstance(t, ast.Attribute) and t.attr == attr)
+                    or (isinstance(t, ast.Name) and t.id == attr)
+                    for t in node.targets
+                )
+                if not targets_attr:
+                    continue
+                if isinstance(node.value, ast.BoolOp) and isinstance(
+                    node.value.op, ast.Or
+                ):
+                    if any(zero_const(v) for v in node.value.values):
+                        return True
+                if zero_const(node.value):
+                    return True
+            if (
+                isinstance(node, ast.AnnAssign)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == attr
+                and node.value is not None
+                and zero_const(node.value)
+            ):
+                return True
+        return False
+
+    def _check_varint_zero_omission(
+        self, module: Module, families: List[_EnumFamily]
+    ) -> Iterator[Finding]:
+        """TPW004 varint leg (ISSUE 17): a zero-omitted PLAIN varint
+        field — ``if x.attr: encode_varint_field(n, x.attr)`` where
+        ``attr`` belongs to no enum family (those are TPW001's beat) —
+        is only safe when a decode path provably re-establishes the
+        integer zero for absent fields. The slo_ms field is the
+        canonical case: 0 must mean "no SLO declared" on BOTH sides,
+        or an old frame without the field decodes differently from a
+        new frame carrying an explicit 0.
+        """
+        parents = parent_map(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+            if fn not in _VARINT_FIELD_EMIT_FNS:
+                continue
+            hit = self._field_of_emit(node)
+            if hit is None:
+                continue
+            attr, _ = hit
+            if self._enum_for_attr(attr, families) is not None:
+                continue
+            if not self._truthiness_guard(parents, node, attr):
+                continue
+            if self._reestablishes_zero(module, attr):
+                continue
+            yield Finding(
+                module.rel,
+                node.lineno,
+                "TPW004",
+                f"varint field '{attr}' is zero-omitted (truthiness "
+                "guard) but no decode path pins the zero default; an "
+                "omitted field must decode identically to an explicit "
+                f"0 — add `x.{attr} = x.{attr} or 0` after parsing (or "
+                "a zero dataclass default)",
+            )
 
     def _reestablishes(self, module: Module, attr: str, const: str) -> bool:
         """Does any decode path restore ``attr`` to ``const``?"""

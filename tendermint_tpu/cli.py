@@ -534,6 +534,13 @@ def cmd_verifyd(args) -> int:
         from tendermint_tpu.libs import tracing
 
         tracing.configure(args.trace)
+    tenant_slos = {}
+    for spec in args.tenant_slo:
+        name, sep, ms = spec.partition("=")
+        if not sep or not name or not ms.isdigit():
+            print(f"bad --tenant-slo {spec!r} (want TENANT=MS)", flush=True)
+            return 2
+        tenant_slos[name] = int(ms)
     host, _, port = args.listen.rpartition(":")
     reg = Registry()
     server = VerifydServer(
@@ -553,6 +560,10 @@ def cmd_verifyd(args) -> int:
         metrics=VerifydMetrics(reg),
         evloop_metrics=EvloopMetrics(reg),
         shm=None if args.shm == "auto" else args.shm,
+        dyn_batch=(
+            None if args.dyn_batch == "auto" else args.dyn_batch == "on"
+        ),
+        tenant_slos=tenant_slos,
     )
     metrics_server = None
     if args.metrics:
@@ -576,11 +587,18 @@ def cmd_verifyd(args) -> int:
         metrics_server.start()
     shost, sport = server.address
     shm_banner = server.shm_socket_path or "off"
+    # the RESOLVED scheduler knobs (post mesh sizing, post controller):
+    # what A/B runs should record as the config actually under test
+    knobs = server.stats().get("scheduler") or {}
     print(
         f"verifyd serving on {shost}:{sport} "
-        f"(max_batch={server.max_batch}, max_delay={args.max_delay}s, "
+        f"(max_batch={knobs.get('max_batch', server.max_batch)}, "
+        f"max_delay={knobs.get('max_delay', args.max_delay)}s, "
         f"admission_cap={args.admission_cap}, "
         f"continuous={server.scheduler.continuous}, "
+        f"pipeline_depth={knobs.get('pipeline_depth', args.pipeline_depth)}, "
+        f"dyn_batch={'on' if server.dyn_batch else 'off'}, "
+        f"tenant_slos={sorted(tenant_slos) if tenant_slos else 'none'}, "
         f"tenant_cap={args.tenant_cap}, "
         f"shm={shm_banner})",
         flush=True,
@@ -1139,6 +1157,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="zero-copy shared-memory ingress for co-located callers "
         "(verifyd/shm.py): auto follows TENDERMINT_TPU_SHM; off is "
         "pure TCP",
+    )
+    p.add_argument(
+        "--dyn-batch", choices=("auto", "on", "off"), default="auto",
+        help="deadline-aware dynamic batching (crypto/adaptive.py): "
+        "auto follows TENDERMINT_TPU_DYN_BATCH (default on); off pins "
+        "the static max-batch/max-delay config",
+    )
+    p.add_argument(
+        "--tenant-slo", action="append", default=[],
+        metavar="TENANT=MS",
+        help="declare a tenant's p99 latency target in ms (repeatable); "
+        "sustained breach sheds that tenant's light/rpc traffic before "
+        "the global brownout ladder moves",
     )
     p.add_argument(
         "--metrics", default="", metavar="HOST:PORT",

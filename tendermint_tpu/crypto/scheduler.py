@@ -29,6 +29,18 @@ lanes, which is the natural backpressure. ``TENDERMINT_TPU_CONT_BATCH=off``
 (or ``continuous=False``) restores the historical flush-barrier path
 where the accumulator verifies inline — kept for A/B benchmarking.
 
+Deadline-aware dynamic batching (crypto/adaptive.py): with
+``dyn_batch=True`` the accumulator resolves ``max_batch``/``max_delay``
+through a :class:`~tendermint_tpu.crypto.adaptive.DynBatchController`
+each iteration — a per-batch-bucket EWMA cost model fed from the flush
+path grows the knobs while the marginal device cost is cheap relative
+to the tightest in-flight ``flush_by`` slack and shrinks them when the
+caller-observed queue wait (``note_queue_wait``) says queueing
+dominates, with hard floors/ceilings and hysteresis on every step.
+Bare schedulers default to static; verifyd resolves its default from
+``TENDERMINT_TPU_DYN_BATCH`` (off = today's static behavior,
+byte-identical flush boundaries).
+
 Serving extensions (used by verifyd, available to any caller):
 
 - per-entry ``priority`` — when more work is pending than one batch
@@ -64,6 +76,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from tendermint_tpu.crypto.adaptive import DynBatchController
 from tendermint_tpu.libs import tracing
 from tendermint_tpu.libs.sanitizer import instrument_attrs
 
@@ -95,6 +108,38 @@ def default_max_batch() -> int:
         return DEFAULT_MAX_BATCH * max(1, mesh.manager.device_count())
     except Exception:  # discovery trouble must not break scheduler setup
         return DEFAULT_MAX_BATCH
+
+
+def resolved_default_knobs() -> dict:
+    """What a scheduler built with default config resolves to *right
+    now*: the mesh-aware batch default plus the env-resolved pipeline
+    and dyn-batch states. The bench child stamps this into every
+    section fragment so A/B artifacts record the config they ran
+    under, not the static constants."""
+    from tendermint_tpu.crypto.adaptive import dyn_batch_default
+
+    return {
+        "max_batch": default_max_batch(),
+        "max_delay": DEFAULT_MAX_DELAY,
+        "pipeline_depth": DEFAULT_PIPELINE_DEPTH,
+        "continuous": continuous_default(),
+        "dyn_batch": dyn_batch_default(),
+    }
+
+
+def _mesh_config_gen() -> Optional[int]:
+    """The mesh manager's config generation, None when the mesh (or its
+    import) is unavailable. The scheduler caches its mesh-aware
+    ``max_batch`` default against this, so a ``configure()`` that lands
+    AFTER the scheduler was built still takes effect at the next flush
+    decision instead of baking the pre-configuration device count in
+    forever (the stale-default bug pinned by tests/test_adaptive.py)."""
+    try:
+        from tendermint_tpu.parallel import mesh
+
+        return mesh.manager.config_gen()
+    except Exception:
+        return None
 
 
 class SchedulerSaturatedError(RuntimeError):
@@ -164,13 +209,36 @@ class VerifyScheduler:
         continuous: Optional[bool] = None,
         pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
         on_dispatch: Optional[Callable[[int, int, str], None]] = None,
+        dyn_batch: Optional[bool] = None,
+        dyn_controller: Optional[DynBatchController] = None,
     ):
         self._verify_fn = verify_fn
         self._fallback_fn = fallback_fn
-        # None = mesh-aware default: 256 lanes per device the sharded
-        # engine can span, so cross-client super-batches fill the mesh.
-        self.max_batch = default_max_batch() if max_batch is None else max_batch
+        # Lazy mesh-aware default: None resolves 256 lanes per device
+        # the sharded engine can span, re-resolved whenever the mesh
+        # config generation moves — a scheduler built before
+        # MeshManager.configure() no longer bakes the pre-config device
+        # count in. The cache rides its own lock because the resolver
+        # runs both bare (stats callers) and under _mtx (the
+        # accumulator); _knob_mtx nests strictly inside _mtx.
+        self._knob_mtx = threading.Lock()
+        self._mb_cache = DEFAULT_MAX_BATCH  # guarded-by: _knob_mtx
+        self._mb_gen: Optional[int] = None  # guarded-by: _knob_mtx
+        self.max_batch = max_batch
         self.max_delay = max_delay
+        # None = static scheduler (the historical behavior, and what
+        # every in-process caller gets); serving front-ends (verifyd)
+        # opt in by passing adaptive.dyn_batch_default() so the
+        # TENDERMINT_TPU_DYN_BATCH env knob governs the service. When
+        # off, no controller exists at all — the flush boundaries are
+        # byte-identical to the static path (pinned by
+        # tests/test_adaptive.py).
+        self.dyn_batch = False if dyn_batch is None else bool(dyn_batch)
+        self._dyn: Optional[DynBatchController] = (
+            (dyn_controller if dyn_controller is not None else DynBatchController())
+            if self.dyn_batch
+            else None
+        )
         # 0 = unbounded (the historical in-process behavior); a serving
         # front-end sets a cap and maps SchedulerSaturatedError to an
         # explicit wire rejection.
@@ -208,6 +276,63 @@ class VerifyScheduler:
         self.dispatch_handoffs = 0  # guarded-by: _mtx
         self.inflight_admissions = 0  # lanes admitted mid-dispatch  # guarded-by: _mtx
         self.flush_reasons = {"size": 0, "deadline": 0, "shutdown": 0}  # guarded-by: _mtx
+
+    # --- knob resolution -----------------------------------------------------
+
+    @property
+    def max_batch(self) -> int:
+        """The static size-flush threshold. Explicit config wins;
+        otherwise the mesh-aware default, cached against the mesh
+        config generation so a post-construction ``configure()`` is
+        picked up at the next read instead of never."""
+        if self._max_batch_cfg is not None:
+            return self._max_batch_cfg
+        gen = _mesh_config_gen()
+        if gen is None:  # mesh unavailable: single-device default
+            return DEFAULT_MAX_BATCH
+        with self._knob_mtx:
+            if gen != self._mb_gen:
+                self._mb_cache = default_max_batch()
+                self._mb_gen = gen
+            return self._mb_cache
+
+    @max_batch.setter
+    def max_batch(self, value: Optional[int]) -> None:
+        self._max_batch_cfg = None if value is None else int(value)
+
+    def _limits(self) -> Tuple[int, float]:
+        """The knobs the accumulator actually runs with this iteration:
+        the static config when dyn-batch is off (byte-identical to the
+        historical path), the controller-scaled resolution otherwise."""
+        mb, md = self.max_batch, self.max_delay
+        if self._dyn is not None:
+            return self._dyn.limits(mb, md)
+        return mb, md
+
+    def note_queue_wait(self, seconds: float) -> None:
+        """Feed the adaptive controller a caller-observed queue wait
+        (verifyd's wire_wait stage — the shrink signal). No-op when
+        dyn-batch is off."""
+        if self._dyn is not None:
+            self._dyn.note_queue_wait(seconds)
+
+    def resolved_knobs(self) -> dict:
+        """The config actually under test right now — what stats(),
+        the CLI banner, and every bench fragment record so A/B runs
+        are attributable to real knob values, not the static ones."""
+        mb, md = self._limits()
+        out = {
+            "max_batch": mb,
+            "max_delay": md,
+            "static_max_batch": self.max_batch,
+            "static_max_delay": self.max_delay,
+            "pipeline_depth": self.pipeline_depth,
+            "continuous": self.continuous,
+            "dyn_batch": self.dyn_batch,
+        }
+        if self._dyn is not None:
+            out["dyn"] = self._dyn.snapshot()
+        return out
 
     # --- lifecycle -----------------------------------------------------------
 
@@ -450,6 +575,10 @@ class VerifyScheduler:
         while True:
             reason = "size"
             with self._wake:
+                # resolved once per wake-up: with dyn-batch on the
+                # controller's latest scale applies to the very next
+                # flush decision; off, these ARE the static attributes.
+                limit, delay = self._limits()
                 while not self._stop:
                     if self.continuous and (
                         self._inflight + len(self._dispatch_q)
@@ -459,16 +588,15 @@ class VerifyScheduler:
                         # (that IS the backpressure); a slot release
                         # notifies _dispatch_wake and we re-evaluate
                         self._dispatch_wake.wait(timeout=0.05)
+                        limit, delay = self._limits()
                         continue
-                    if len(self._pending) >= self.max_batch:
+                    if len(self._pending) >= limit:
                         reason = "size"
                         break
                     if self._pending:
                         # earliest obligation across max_delay AND any
                         # per-entry wire deadline (flush_by)
-                        due = min(
-                            p.due(self.max_delay) for p in self._pending
-                        )
+                        due = min(p.due(delay) for p in self._pending)
                         wait = due - time.monotonic()
                         if wait <= 0:
                             reason = "deadline"
@@ -476,16 +604,17 @@ class VerifyScheduler:
                         self._wake.wait(timeout=wait)
                     else:
                         self._wake.wait(timeout=0.1)
+                    limit, delay = self._limits()
                 if self._stop:
                     return
-                if len(self._pending) > self.max_batch:
+                if len(self._pending) > limit:
                     # over-subscribed: highest-priority (lowest value)
                     # lanes flush first, FIFO within a class
                     order = sorted(
                         self._pending,
                         key=lambda p: (p.priority, p.submitted),
                     )
-                    batch = order[: self.max_batch]
+                    batch = order[:limit]
                     taken = {id(p) for p in batch}
                     self._pending = [
                         p for p in self._pending if id(p) not in taken
@@ -616,6 +745,23 @@ class VerifyScheduler:
                         oks = [False] * len(pks)
         if len(oks) != len(pks):  # misbehaving verifier: fail closed
             oks = [False] * len(pks)
+        dev_s = time.monotonic() - t0
+        if self._dyn is not None and batch:
+            # the controller's flush feed (same site the on_flush
+            # observer fires from): batch residency = dispatch minus
+            # oldest submit, slack = tightest wire-deadline headroom
+            # still unspent at dispatch (None when no lane carried one)
+            residency = max(
+                0.0, t_dispatch - min(p.submitted for p in batch)
+            )
+            slack: Optional[float] = None
+            for p in batch:
+                if p.flush_by is not None:
+                    s = p.flush_by - t_dispatch
+                    slack = s if slack is None else min(slack, s)
+            self._dyn.observe_flush(
+                len(batch), residency, dev_s, slack, self.max_delay
+            )
         with self._mtx:
             self.flushes += 1
             self.flush_reasons[reason] += 1

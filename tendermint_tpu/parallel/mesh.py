@@ -135,6 +135,7 @@ class MeshManager:
         self.cooldown_base = cooldown_base
         self.cooldown_max = cooldown_max
         self._configured = 0  # [ops] mesh_devices cap; 0 = unset  # guarded-by: _mtx
+        self._config_gen = 0  # bumped per configure()/reset()  # guarded-by: _mtx
         self._devices: Optional[tuple] = None  # discovery cache  # guarded-by: _mtx
         self._health: Dict[int, device_policy.DeviceHealth] = {}  # guarded-by: _mtx
         self._meshes: Dict[Tuple[int, ...], object] = {}  # Mesh per id-set  # guarded-by: _mtx
@@ -152,6 +153,15 @@ class MeshManager:
         TENDERMINT_TPU_MESH env var applies only when this is 0)."""
         with self._mtx:
             self._configured = max(0, int(n_devices or 0))
+            self._config_gen += 1
+
+    def config_gen(self) -> int:
+        """Monotone configuration generation (bumped by configure() and
+        reset()). Consumers that cache anything derived from the mesh
+        size — the scheduler's mesh-aware max_batch default — compare
+        against this instead of baking a pre-configuration value in."""
+        with self._mtx:
+            return self._config_gen
 
     def bind_metrics(self, metrics) -> None:
         """Mirror mesh activity into a libs/metrics.OpsMetrics. Last
@@ -165,6 +175,7 @@ class MeshManager:
         """Tests/operator: drop all per-device state and overrides."""
         with self._mtx:
             self._configured = 0
+            self._config_gen += 1
             self._devices = None
             self._health.clear()
             self.exclusions = 0

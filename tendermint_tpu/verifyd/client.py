@@ -148,6 +148,7 @@ class VerifydClient:
         shed_backoff: float = 0.02,
         shm: Optional[str] = None,
         metrics: Optional[VerifydMetrics] = None,
+        slo_ms: int = 0,
     ):
         host, _, port = addr.rpartition(":")
         if not host or not port.isdigit():
@@ -160,6 +161,10 @@ class VerifydClient:
         self.backoff = backoff
         self.fallback = fallback
         self.tenant = tenant or DEFAULT_TENANT
+        # declared p99 target for this tenant's traffic (protocol field
+        # 8, zero = none): the server holds the tenant's attributed
+        # latency budget to it (tightest declaration wins server-side)
+        self.slo_ms = max(0, int(slo_ms))
         # RESOURCE_EXHAUSTED retry budget: sheds are transient (the
         # server's brownout ladder recovers), so wait-and-retry against
         # the remaining deadline before surrendering to the fallback
@@ -353,6 +358,7 @@ class VerifydClient:
                 sigs=list(req.sigs[start:end]),
                 tenant=req.tenant,
                 trace=req.trace,  # every split rides the same trace
+                slo_ms=req.slo_ms,
             )
             resp = self.call(sub, timeout=timeout)
             if resp.status != STATUS_OK:
@@ -465,6 +471,7 @@ class VerifydClient:
                     sigs=list(sigs),
                     tenant=self.tenant,
                     trace=trace_bytes,
+                    slo_ms=self.slo_ms,
                 )
                 try:
                     # transport grace past the verify deadline: the

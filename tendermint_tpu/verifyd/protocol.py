@@ -28,6 +28,12 @@ Request (proto wire form):
                           emits frames byte-identical to before the
                           field existed and the decoder maps absence
                           back to the empty (no-trace) default
+    8  slo_ms    varint   tenant p99 latency target in ms (the SLO the
+                          adaptive server holds this tenant's budget
+                          to); 0 = no declared target and is OMITTED
+                          (zero-omission: a pre-SLO client emits frames
+                          byte-identical to before the field existed,
+                          and the decoder maps absence back to 0)
 
 Response:
     1  status       varint   OK | RESOURCE_EXHAUSTED | DEADLINE_EXCEEDED
@@ -121,6 +127,11 @@ MAX_TENANT_LEN = 64  # wire-level cap; the server additionally hashes/caps
 # must OMIT it when empty, the same zero-omission symmetry as tenant.
 MAX_TRACE_LEN = 64  # wire-level cap; today's context is 17 bytes
 
+# tenant SLO declaration (field 8): 0 = no target, omitted on the wire
+# (zero-omission symmetry again). Capped so a hostile client can't
+# declare an absurd target that skews the server's budget arithmetic.
+MAX_SLO_MS = 600_000  # 10 minutes — far beyond any real latency SLO
+
 # End-to-end latency attribution stage vector (response field 5), in
 # wire order. Each stage is one f32 of seconds summed from the server's
 # real spans; together they account for the server-side request wall.
@@ -155,6 +166,7 @@ class VerifyRequest:
     sigs: List[bytes] = field(default_factory=list)
     tenant: str = DEFAULT_TENANT
     trace: bytes = b""
+    slo_ms: int = 0
 
     def __len__(self) -> int:
         return len(self.pks)
@@ -195,6 +207,8 @@ def encode_request(req: VerifyRequest) -> bytes:
         out += encode_string_field(6, req.tenant)
     if req.trace:
         out += encode_bytes_field(7, req.trace)
+    if req.slo_ms:
+        out += encode_varint_field(8, req.slo_ms)
     return bytes(out)
 
 
@@ -231,6 +245,8 @@ def encoded_request_size(req: VerifyRequest) -> int:
         size += 1 + _varint_size(len(tenant)) + len(tenant)
     if req.trace:
         size += 1 + _varint_size(len(req.trace)) + len(req.trace)
+    if req.slo_ms:
+        size += 1 + _varint_size(req.slo_ms)
     return size
 
 
@@ -270,6 +286,8 @@ def decode_request(data: bytes) -> VerifyRequest:
                 req.tenant = r.read_bytes().decode("utf-8", "replace")
             elif fld == 7 and wire == WIRE_BYTES:
                 req.trace = r.read_bytes()
+            elif fld == 8 and wire == WIRE_VARINT:
+                req.slo_ms = r.read_varint()
             else:
                 r.skip(wire)
     except ValueError:
@@ -282,6 +300,10 @@ def decode_request(data: bytes) -> VerifyRequest:
     # absence (pre-trace client) means no trace context — re-establish
     # the encoder's omitted empty default the same way (TPW004)
     req.trace = req.trace or b""
+    # absence (pre-SLO client) means no declared target (TPW004)
+    req.slo_ms = req.slo_ms or 0
+    if req.slo_ms > MAX_SLO_MS:
+        raise ValueError(f"slo_ms too large: {req.slo_ms}")
     if len(req.tenant) > MAX_TENANT_LEN:
         raise ValueError(f"tenant name too long: {len(req.tenant)}")
     if len(req.trace) > MAX_TRACE_LEN:

@@ -28,7 +28,19 @@ launch as a validator's commit flood. Scheduling behavior:
   the tenant budget, queue depth, or estimated service time exceeds
   budget. ``consensus``/``blocksync`` are never shed by admission
   (losing them stalls the chain, not just a reader); they land in the
-  scheduler's own ``max_pending`` backstop instead.
+  scheduler's own ``max_pending`` backstop instead;
+- per-tenant SLO budgets: a tenant may declare a p99 latency target
+  (``--tenant-slo name=ms`` server-side, or protocol field 8 from the
+  client — the tightest wins, operator config beats the wire). The
+  server keeps a bounded sketch of each tenant's attributed latency
+  (the same wall the stage vector tiles) and, on a sustained p99
+  breach, sheds that tenant's sheddable classes — scoped to the
+  tenant, BEFORE the load-based ladder moves — releasing on the same
+  hysteresis-clock shape the ladder uses;
+- adaptive serving: schedulers run with deadline-aware dynamic
+  batching (``crypto/adaptive.py``) unless ``TENDERMINT_TPU_DYN_BATCH=off``
+  (or ``dyn_batch=False``) pins the static config; ``stats()`` reports
+  the knobs actually in force under ``"scheduler"``.
 
 Brownout ladder (the documented degradation contract, see README):
 under SUSTAINED overload — or device COOLDOWN — the server walks an
@@ -58,11 +70,11 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.crypto.adaptive import dyn_batch_default
 from tendermint_tpu.crypto.scheduler import (
     DEFAULT_PIPELINE_DEPTH,
     SchedulerSaturatedError,
     VerifyScheduler,
-    default_max_batch,
 )
 from tendermint_tpu.libs import tracing
 from tendermint_tpu.libs.grpc import GrpcServer, current_conn_tag
@@ -99,6 +111,23 @@ DEFAULT_PIN_QUOTA = 256  # resident-table pins per tenant
 DEFAULT_MAX_TENANTS = 16  # distinct tenant label/budget buckets
 _EWMA_ALPHA = 0.2
 _SHRINK_DIVISOR = 4  # tenant share divisor at the shrink_shares rung
+
+# --- per-tenant SLO budgets --------------------------------------------------
+# A tenant may declare a p99 latency target (``--tenant-slo name=ms`` or
+# protocol field 8). The server keeps a bounded ring of attributed
+# server-side latencies per tenant (the same wall the stage vector
+# tiles) and, when the tenant's p99 drifts past its target for
+# ``slo_breach_after`` seconds, sheds that tenant's SHEDDABLE classes
+# scoped to the tenant — BEFORE the load-based brownout ladder would
+# move, and without touching any other tenant. Release rides the same
+# hysteresis-clock shape as the ladder: after ``slo_recover_after`` of
+# shedding the gate opens and the sample ring resets, so the verdict on
+# re-breach comes from fresh post-recovery samples, not the stale storm.
+SLO_BREACH_AFTER = 0.25  # sustained p99 breach before the scoped shed
+SLO_RECOVER_AFTER = 1.0  # shed dwell before release (ring resets)
+_SLO_RING = 512  # latency samples kept per tenant
+_SLO_RECOMPUTE = 16  # recompute the cached p99 every N samples
+_SLO_MIN_SAMPLES = 20  # no verdicts from a cold sketch
 
 # --- brownout ladder ---------------------------------------------------------
 
@@ -268,7 +297,12 @@ class _TenantState:
     ``_tenant_mtx`` (one lock for the whole registry: tenant counts are
     bounded and the critical sections are tiny)."""
 
-    __slots__ = ("label", "depth", "lanes", "sheds", "host_direct")
+    __slots__ = (
+        "label", "depth", "lanes", "sheds", "host_direct",
+        "slo_ms", "slo_pinned", "lat_ring", "lat_idx", "lat_new",
+        "p99", "slo_breach_since", "slo_shed_since", "slo_shedding",
+        "slo_sheds",
+    )
 
     def __init__(self, label: str):
         self.label = label
@@ -276,6 +310,18 @@ class _TenantState:
         self.lanes = 0  # total lanes admitted
         self.sheds = 0  # total requests shed
         self.host_direct = 0  # lanes verified on the host oracle
+        # SLO budget: declared p99 target (0 = none) and the bounded
+        # attributed-latency sketch that polices it
+        self.slo_ms = 0  # declared p99 target; 0 = no SLO
+        self.slo_pinned = False  # server-config target beats the wire's
+        self.lat_ring: List[float] = []  # bounded latency samples (s)
+        self.lat_idx = 0  # ring write cursor
+        self.lat_new = 0  # samples since the last p99 recompute
+        self.p99 = 0.0  # cached ring p99 (seconds)
+        self.slo_breach_since: Optional[float] = None
+        self.slo_shed_since: Optional[float] = None
+        self.slo_shedding = False
+        self.slo_sheds = 0  # requests shed by the SLO gate
 
 
 # --- admission ---------------------------------------------------------------
@@ -374,6 +420,10 @@ class VerifydServer:
         max_tenants: int = DEFAULT_MAX_TENANTS,
         brownout: Optional[BrownoutController] = None,
         shm: Optional[str] = None,
+        dyn_batch: Optional[bool] = None,
+        tenant_slos: Optional[Dict[str, int]] = None,
+        slo_breach_after: float = SLO_BREACH_AFTER,
+        slo_recover_after: float = SLO_RECOVER_AFTER,
     ):
         self.metrics = metrics or VerifydMetrics.nop()
         self.max_delay = max_delay
@@ -382,6 +432,13 @@ class VerifydServer:
         self.tenant_cap = tenant_cap
         self.tenant_pin_quota = tenant_pin_quota
         self.max_tenants = max(1, max_tenants)
+        self.slo_breach_after = slo_breach_after
+        self.slo_recover_after = slo_recover_after
+        # None = env default: the serving tier is adaptive unless
+        # TENDERMINT_TPU_DYN_BATCH=off pins the static scheduler
+        self.dyn_batch = (
+            dyn_batch_default() if dyn_batch is None else bool(dyn_batch)
+        )
         self._verify_fns = {
             ALGO_ED25519: (
                 verify_fn or crypto_batch.tiered_verify_ed25519,
@@ -392,14 +449,17 @@ class VerifydServer:
                 _host_sr25519_verify,
             ),
         }
-        # None = mesh-aware default (256 lanes per device the sharded
-        # engine spans) so cross-client super-batches fill every chip.
+        # None = mesh-aware default, resolved LAZILY by the scheduler
+        # against the mesh config generation — a server built before
+        # MeshManager.configure() no longer bakes the pre-config device
+        # count into max_batch (the stale-default fix).
         self._sched_args = dict(
-            max_batch=default_max_batch() if max_batch is None else max_batch,
+            max_batch=max_batch,
             max_delay=max_delay,
             max_pending=max_pending,
             continuous=continuous,
             pipeline_depth=pipeline_depth,
+            dyn_batch=self.dyn_batch,
         )
         self._schedulers: Dict[int, VerifyScheduler] = {}  # guarded-by: _sched_mtx
         self._sched_mtx = threading.Lock()
@@ -436,6 +496,13 @@ class VerifydServer:
             {VERIFY_PATH: self._handle}, host, port,
             evloop_metrics=evloop_metrics,
         )
+        # operator-declared p99 targets (--tenant-slo name=ms): pinned,
+        # so a wire-declared target (protocol field 8) never loosens them
+        for name, slo_ms in (tenant_slos or {}).items():
+            ts = self._tenant_for(name)
+            with self._tenant_mtx:
+                ts.slo_ms = max(0, int(slo_ms))
+                ts.slo_pinned = True
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -445,8 +512,10 @@ class VerifydServer:
 
     @property
     def max_batch(self) -> int:
-        """Resolved size-flush threshold (mesh-aware when defaulted)."""
-        return self._sched_args["max_batch"]
+        """Resolved size-flush threshold (mesh-aware when defaulted) —
+        delegated to the scheduler, which re-resolves the mesh-aware
+        default whenever the mesh configuration generation moves."""
+        return self.scheduler.max_batch
 
     @property
     def scheduler(self) -> VerifyScheduler:
@@ -559,6 +628,12 @@ class VerifydServer:
         synchronization edge the counters ride on."""
         with self._shm_mtx:
             ep = self._shm_endpoint
+        # resolved scheduler knobs (the config actually under test):
+        # snapshot the LIVE scheduler if one exists — stats() must not
+        # resurrect a scheduler after stop()
+        with self._sched_mtx:
+            sched = self._schedulers.get(ALGO_ED25519)
+        knobs = sched.resolved_knobs() if sched is not None else None
         with self._stats_mtx:
             return {
                 "requests_served": self.requests_served,
@@ -570,6 +645,7 @@ class VerifydServer:
                 "shm_torn_slabs": self.shm_torn_slabs,
                 "shm_fallbacks": self.shm_fallbacks,
                 "shm_sessions": ep.session_count() if ep is not None else 0,
+                "scheduler": knobs,
             }
 
     def tenant_stats(self) -> Dict[str, Dict[str, int]]:
@@ -583,6 +659,10 @@ class VerifydServer:
                         "lanes": ts.lanes,
                         "sheds": ts.sheds,
                         "host_direct": ts.host_direct,
+                        "slo_ms": ts.slo_ms,
+                        "slo_sheds": ts.slo_sheds,
+                        "slo_shedding": ts.slo_shedding,
+                        "p99_ms": round(ts.p99 * 1000.0, 3),
                     }
         return out
 
@@ -612,6 +692,86 @@ class VerifydServer:
         if level >= LEVEL_SHRINK_SHARES:
             return max(1, self.tenant_cap // _SHRINK_DIVISOR)
         return self.tenant_cap
+
+    # --- per-tenant SLO budgets ---------------------------------------------
+
+    def _tenant_declare_slo(self, ts: _TenantState, slo_ms: int) -> None:
+        """Wire-declared target (protocol field 8): adopted unless the
+        operator pinned one via --tenant-slo; the TIGHTEST wire value
+        wins so one lax client can't loosen its tenant's budget."""
+        if slo_ms <= 0:
+            return
+        with self._tenant_mtx:
+            if ts.slo_pinned:
+                return
+            if ts.slo_ms == 0 or slo_ms < ts.slo_ms:
+                ts.slo_ms = slo_ms
+
+    def _tenant_observe_latency(
+        self, ts: _TenantState, seconds: float, now: Optional[float] = None
+    ) -> None:
+        """Fold one attributed server-side latency (the wall the stage
+        vector tiles) into the tenant's sketch and run the breach
+        hysteresis. ``now`` is injectable for synthetic-clock tests."""
+        now = time.monotonic() if now is None else now
+        with self._tenant_mtx:
+            if len(ts.lat_ring) < _SLO_RING:
+                ts.lat_ring.append(seconds)
+            else:
+                ts.lat_ring[ts.lat_idx] = seconds
+                ts.lat_idx = (ts.lat_idx + 1) % _SLO_RING
+            ts.lat_new += 1
+            if ts.lat_new >= _SLO_RECOMPUTE or ts.p99 == 0.0:
+                ts.lat_new = 0
+                ordered = sorted(ts.lat_ring)
+                ts.p99 = ordered[max(0, int(len(ordered) * 0.99) - 1)]
+            if ts.slo_ms <= 0 or ts.slo_shedding:
+                return
+            if (
+                len(ts.lat_ring) >= _SLO_MIN_SAMPLES
+                and ts.p99 > ts.slo_ms / 1000.0
+            ):
+                if ts.slo_breach_since is None:
+                    ts.slo_breach_since = now
+                elif now - ts.slo_breach_since >= self.slo_breach_after:
+                    # sustained breach: tenant-scoped brownout, BEFORE
+                    # the load-based ladder has any reason to move
+                    ts.slo_shedding = True
+                    ts.slo_shed_since = now
+                    ts.slo_breach_since = None
+                    tracing.instant(
+                        "verifyd_tenant_slo_breach",
+                        tenant=ts.label,
+                        p99_ms=round(ts.p99 * 1000.0, 3),
+                        slo_ms=ts.slo_ms,
+                    )
+            else:
+                ts.slo_breach_since = None
+
+    def _tenant_slo_gate(
+        self, ts: _TenantState, now: Optional[float] = None
+    ) -> bool:
+        """True while the tenant's sheddable classes are SLO-shed.
+        Release is the existing hysteresis-clock shape: after
+        ``slo_recover_after`` of shedding the gate opens and the sample
+        ring resets, so re-breach verdicts come from fresh samples."""
+        now = time.monotonic() if now is None else now
+        with self._tenant_mtx:
+            if not ts.slo_shedding:
+                return False
+            if (
+                ts.slo_shed_since is not None
+                and now - ts.slo_shed_since >= self.slo_recover_after
+            ):
+                ts.slo_shedding = False
+                ts.slo_shed_since = None
+                ts.lat_ring = []
+                ts.lat_idx = 0
+                ts.lat_new = 0
+                ts.p99 = 0.0
+                return False
+            ts.slo_sheds += 1
+            return True
 
     # --- flush / dispatch observers -----------------------------------------
 
@@ -838,12 +998,18 @@ class VerifydServer:
             kind_name = KIND_NAMES[req.kind]
             klass_name = CLASS_NAMES[req.klass]
             ts = self._tenant_for(req.tenant)
+            if req.slo_ms:
+                self._tenant_declare_slo(ts, req.slo_ms)
             n = len(req)
             if n == 0:
                 return self._respond(
                     STATUS_OK, [], "", t0, kind_name, tenant_label=ts.label
                 )
             sched = self._scheduler_for(req.algo)
+            # the caller-observed wire/decode wait is the adaptive
+            # controller's shrink signal (queueing ahead of the
+            # accumulator dominating the flush deadline)
+            sched.note_queue_wait(t_entry - t0)
             deadline_s = req.deadline_ms / 1000.0 if req.deadline_ms else 0.0
 
             # load_depth counts in-flight lanes too: on the continuous
@@ -865,6 +1031,19 @@ class VerifydServer:
                     "verifyd_brownout",
                     level=LEVEL_NAMES[level],
                     direction=direction,
+                )
+
+            # per-tenant SLO gate, BEFORE the load-based ladder: a
+            # tenant whose attributed p99 drifted past its declared
+            # budget sheds ITS OWN sheddable classes while every other
+            # tenant — and the global ladder — is untouched. Consensus
+            # and blocksync are exempt exactly as on the ladder.
+            if req.klass in SHEDDABLE_CLASSES and self._tenant_slo_gate(ts):
+                return self._shed(
+                    ts, klass_name, "slo", n,
+                    f"tenant {ts.label} over SLO budget"
+                    f" ({ts.slo_ms}ms p99 target)",
+                    t0, kind_name, depth,
                 )
 
             # ladder rungs 1-3: whole-class sheds (rpc -> light ->
@@ -996,6 +1175,8 @@ class VerifydServer:
                 "device": t_fin - t_disp,
                 "collect": now - t_fin,
             }
+            # the SLO sketch eats the same wall the stage vector tiles
+            self._tenant_observe_latency(ts, now - t0, now)
             return self._respond(
                 STATUS_OK, verdicts, "", t0, kind_name,
                 sched.pending_depth(), tenant_label=ts.label,

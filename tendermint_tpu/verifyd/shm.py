@@ -97,7 +97,7 @@ from tendermint_tpu.verifyd.protocol import (
 )
 
 SHM_ENV = "TENDERMINT_TPU_SHM"
-SHM_VERSION = 2  # v2: trace-context header words + stage vector on RESP
+SHM_VERSION = 3  # v3: slo_ms header word (v2: trace words + stage vector)
 SHM_MAGIC = 0x54_4D_54_50_55_53_4C_42  # "TMTPUSLB"
 
 # per-request lane cap on the slab path; one 2 MiB slab holds an
@@ -132,8 +132,9 @@ SLAB_OFF_LANES = 20  # u32
 SLAB_OFF_TENANT_LEN = 24  # u32, 0 = DEFAULT_TENANT (zero-omission)
 SLAB_OFF_TENANT = 28  # MAX_TENANT_LEN bytes, utf-8, zero-padded
 SLAB_OFF_TRACE = 92  # TraceContext wire form (17B), all-zero = absent
-SLAB_OFF_GEN2 = 112  # u32 trailing seqlock stamp
-SLAB_HEADER_BYTES = 116
+SLAB_OFF_SLO_MS = 112  # u32 tenant p99 target, 0 = no declared SLO
+SLAB_OFF_GEN2 = 116  # u32 trailing seqlock stamp
+SLAB_HEADER_BYTES = 120
 
 # the fixed trace-context wire form (tracing.CTX_WIRE_LEN): 8B trace
 # id, 8B span id, 1B flags — stored verbatim so the drain path hands
@@ -191,6 +192,7 @@ def pack_header(
     lanes: int,
     tenant: str = DEFAULT_TENANT,
     trace: bytes = b"",
+    slo_ms: int = 0,
 ) -> None:
     """Publish a slab header. The caller has already written the lane
     table + payload and stamped ``stamp_begin``; this writes every
@@ -218,6 +220,10 @@ def pack_header(
     buf[base + SLAB_OFF_TRACE : base + SLAB_OFF_TRACE + _TRACE_WIRE_LEN] = (
         raw_trace
     )
+    # written (or zeroed) unconditionally for the same slab-reuse
+    # reason as trace: 0 decodes as "no declared SLO" (zero-omission,
+    # matching protocol field 8)
+    struct.pack_into("<I", buf, base + SLAB_OFF_SLO_MS, max(0, slo_ms))
     # publication order matters: GEN2 first, GEN last — a reader that
     # sees GEN even must also see GEN2 agree, or the slab is torn
     struct.pack_into("<I", buf, base + SLAB_OFF_GEN2, gen)
@@ -238,6 +244,7 @@ def unpack_header(buf, base: int) -> dict:
     raw_trace = bytes(
         buf[base + SLAB_OFF_TRACE : base + SLAB_OFF_TRACE + _TRACE_WIRE_LEN]
     )
+    (slo_ms,) = struct.unpack_from("<I", buf, base + SLAB_OFF_SLO_MS)
     (gen2,) = struct.unpack_from("<I", buf, base + SLAB_OFF_GEN2)
     if gen % 2 == 1 or gen != gen2:
         raise ValueError(f"torn slab: generation {gen}/{gen2}")
@@ -254,6 +261,8 @@ def unpack_header(buf, base: int) -> dict:
         raise ValueError(f"too many lanes: {lanes} > {SHM_MAX_LANES}")
     if tenant_len > MAX_TENANT_LEN:
         raise ValueError(f"tenant name too long: {tenant_len}")
+    if slo_ms > protocol.MAX_SLO_MS:
+        raise ValueError(f"slo_ms too large: {slo_ms}")
     if tenant_len:
         raw = bytes(buf[base + SLAB_OFF_TENANT : base + SLAB_OFF_TENANT + tenant_len])
         tenant = raw.decode("utf-8", "replace")
@@ -270,6 +279,7 @@ def unpack_header(buf, base: int) -> dict:
         # all-zero trace id = absent (zeroed/old header): re-establish
         # the same empty default decode_request applies
         "trace": raw_trace if any(raw_trace[:8]) else b"",
+        "slo_ms": slo_ms,
     }
 
 
@@ -733,6 +743,7 @@ class _ShmSession:
             sigs=sigs,
             tenant=hdr["tenant"],
             trace=hdr["trace"],
+            slo_ms=hdr["slo_ms"],
         )
         # lanes are now the scheduler's problem; they stop counting as
         # ring backlog the moment the serve path (admission included)
@@ -1174,6 +1185,7 @@ class ShmClientTransport:
             lanes=len(req),
             tenant=req.tenant,
             trace=req.trace,
+            slo_ms=req.slo_ms,
         )
 
     def _send_commit(self, seq: int, slot: int, lanes: int) -> None:

@@ -1,0 +1,439 @@
+"""ISSUE 17: SLO-driven adaptive serving — the dyn-batch controller
+(synthetic-clock hysteresis, cost-model convergence, off-parity with
+the static scheduler) and the per-tenant SLO budget machinery
+(breach -> tenant-scoped shed -> recovery)."""
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto import adaptive
+from tendermint_tpu.crypto.adaptive import (
+    DYN_BATCH_ENV,
+    BatchCostModel,
+    DynBatchController,
+    dyn_batch_default,
+)
+from tendermint_tpu.crypto.scheduler import (
+    DEFAULT_MAX_BATCH,
+    VerifyScheduler,
+)
+from tendermint_tpu.verifyd import server as server_mod
+from tendermint_tpu.verifyd.client import VerifydClient, VerifydRejectedError
+from tendermint_tpu.verifyd.server import VerifydServer
+
+
+def ok_verify(pks, msgs, sigs):
+    return [True] * len(pks)
+
+
+class FakeClock:
+    """Injectable monotonic clock: hysteresis without sleeping."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def warm_controller(ctl, lanes=8, device_s=0.001):
+    """Feed MIN_BUCKET_SAMPLES neutral-ish flushes so the cost model
+    can produce predictions (votes before warmth are neutral)."""
+    for _ in range(adaptive.MIN_BUCKET_SAMPLES):
+        # tiny positive slack: marginal prediction (once warm) exceeds
+        # half of it, so these observations cast NO vote either way
+        ctl.observe_flush(lanes, 0.001, device_s, device_s * 0.1, 0.002)
+
+
+# --- controller hysteresis (synthetic clock) -------------------------------
+
+
+class TestControllerHysteresis:
+    def grow(self, ctl, lanes=8):
+        # huge slack: the warm model's marginal cost is trivially within
+        # GROW_SLACK_FRACTION of it
+        ctl.observe_flush(lanes, 0.001, 0.001, 1.0, 0.002)
+
+    def shrink(self, ctl, lanes=8):
+        # negative slack = the wire deadline was already blown at
+        # dispatch: unconditional shrink vote
+        ctl.observe_flush(lanes, 0.001, 0.001, -1.0, 0.002)
+
+    def neutral(self, ctl, lanes=8):
+        # slack too small for the marginal cost, no queue wait: no vote
+        ctl.observe_flush(lanes, 0.001, 0.001, 1e-6, 0.002)
+
+    def test_grow_needs_consecutive_votes(self):
+        clock = FakeClock()
+        ctl = DynBatchController(clock=clock)
+        warm_controller(ctl)
+        for _ in range(adaptive.VOTES_NEEDED - 1):
+            self.grow(ctl)
+        assert ctl.scale == 1.0
+        self.grow(ctl)
+        assert ctl.scale == pytest.approx(adaptive.GROW_STEP)
+        assert ctl.snapshot()["steps_up"] == 1
+
+    def test_dwell_gates_consecutive_steps(self):
+        clock = FakeClock()
+        ctl = DynBatchController(clock=clock)
+        warm_controller(ctl)
+        for _ in range(adaptive.VOTES_NEEDED):
+            self.grow(ctl)
+        assert ctl.scale == pytest.approx(adaptive.GROW_STEP)
+        # votes keep landing inside the dwell window: no second step
+        for _ in range(adaptive.VOTES_NEEDED * 3):
+            self.grow(ctl)
+        assert ctl.scale == pytest.approx(adaptive.GROW_STEP)
+        clock.advance(adaptive.STEP_DWELL + 0.01)
+        self.grow(ctl)
+        assert ctl.scale == pytest.approx(adaptive.GROW_STEP**2)
+
+    def test_shrink_on_blown_slack_with_hysteresis(self):
+        clock = FakeClock()
+        ctl = DynBatchController(clock=clock)
+        for _ in range(adaptive.VOTES_NEEDED - 1):
+            self.shrink(ctl)
+        assert ctl.scale == 1.0
+        self.shrink(ctl)
+        assert ctl.scale == pytest.approx(adaptive.SHRINK_STEP)
+        assert ctl.snapshot()["steps_down"] == 1
+
+    def test_shrink_on_queue_wait_signal(self):
+        clock = FakeClock()
+        ctl = DynBatchController(clock=clock)
+        # caller-observed queue wait far above half the resolved delay
+        for _ in range(8):
+            ctl.note_queue_wait(0.05)
+        for _ in range(adaptive.VOTES_NEEDED):
+            ctl.observe_flush(8, 0.001, 0.001, 1e-6, 0.002)
+        assert ctl.scale == pytest.approx(adaptive.SHRINK_STEP)
+
+    def test_neutral_vote_resets_both_streaks(self):
+        clock = FakeClock()
+        ctl = DynBatchController(clock=clock)
+        for _ in range(adaptive.VOTES_NEEDED - 1):
+            self.shrink(ctl)
+        self.neutral(ctl)  # cold model + tiny slack: no vote
+        for _ in range(adaptive.VOTES_NEEDED - 1):
+            self.shrink(ctl)
+        assert ctl.scale == 1.0  # streak restarted after the neutral
+        self.shrink(ctl)
+        assert ctl.scale == pytest.approx(adaptive.SHRINK_STEP)
+
+    def test_scale_clamps_and_delay_cap(self):
+        clock = FakeClock()
+        ctl = DynBatchController(clock=clock)
+        warm_controller(ctl)
+        for _ in range(200):
+            self.grow(ctl)
+            clock.advance(adaptive.STEP_DWELL + 0.01)
+        assert ctl.scale == adaptive.SCALE_MAX
+        mb, md = ctl.limits(4, 0.002)
+        assert mb == int(4 * adaptive.SCALE_MAX)
+        # the delay knob is capped tighter than the batch knob
+        assert md == pytest.approx(0.002 * adaptive.DELAY_SCALE_MAX)
+        for _ in range(200):
+            self.shrink(ctl)
+            clock.advance(adaptive.STEP_DWELL + 0.01)
+        assert ctl.scale == pytest.approx(adaptive.SCALE_MIN)
+        mb, md = ctl.limits(4, 0.002)
+        assert mb == max(1, int(4 * adaptive.SCALE_MIN))
+        assert md >= 0.002 * adaptive.SCALE_MIN
+
+
+# --- cost model ------------------------------------------------------------
+
+
+class TestCostModel:
+    def test_converges_on_fake_flush_stream(self):
+        model = BatchCostModel()
+        # a wild first sample, then a steady stream: the EWMA must
+        # converge to the steady cost
+        model.observe(8, 0.05, 0.05)
+        for _ in range(60):
+            model.observe(8, 0.002, 0.010)
+        assert model.device_cost(8) == pytest.approx(0.010, abs=1e-4)
+        assert model.residency_cost(8) == pytest.approx(0.002, abs=1e-4)
+
+    def test_cold_buckets_give_no_predictions(self):
+        model = BatchCostModel()
+        assert model.device_cost(8) is None
+        assert model.marginal_device_cost(8) is None
+        model.observe(8, 0.001, 0.01)  # 1 sample < MIN_BUCKET_SAMPLES
+        assert model.device_cost(8) is None
+
+    def test_marginal_from_measured_adjacent_buckets(self):
+        model = BatchCostModel()
+        for _ in range(adaptive.MIN_BUCKET_SAMPLES):
+            model.observe(8, 0.001, 0.010)
+            model.observe(16, 0.001, 0.011)
+        # both buckets warm: the marginal is the measured difference,
+        # NOT the doubling guess
+        assert model.marginal_device_cost(8) == pytest.approx(
+            0.001, abs=1e-4
+        )
+
+    def test_extrapolation_is_conservative(self):
+        model = BatchCostModel()
+        for _ in range(adaptive.MIN_BUCKET_SAMPLES):
+            model.observe(16, 0.001, 0.010)
+        # cold upper bucket: linear per-lane scaling from the warm one
+        assert model.device_cost(64) == pytest.approx(0.040, abs=1e-4)
+        # cold upper bucket's marginal falls back to "doubling doubles"
+        assert model.marginal_device_cost(16) == pytest.approx(
+            0.010, abs=1e-4
+        )
+
+
+# --- env default and off-parity --------------------------------------------
+
+
+class TestDynBatchOff:
+    def test_env_default_resolution(self, monkeypatch):
+        for off in ("off", "0", "false", "no"):
+            monkeypatch.setenv(DYN_BATCH_ENV, off)
+            assert dyn_batch_default() is False
+        for on in ("on", "1", "true", "anything"):
+            monkeypatch.setenv(DYN_BATCH_ENV, on)
+            assert dyn_batch_default() is True
+        monkeypatch.delenv(DYN_BATCH_ENV)
+        assert dyn_batch_default() is True
+
+    def test_bare_scheduler_defaults_static(self):
+        s = VerifyScheduler(ok_verify, max_batch=8)
+        assert s._dyn is None
+        assert s.resolved_knobs()["dyn_batch"] is False
+        assert "dyn" not in s.resolved_knobs()
+
+    def test_server_honors_env_off(self, monkeypatch):
+        monkeypatch.setenv(DYN_BATCH_ENV, "off")
+        srv = VerifydServer(verify_fn=ok_verify)
+        try:
+            assert srv.dyn_batch is False
+            assert srv.scheduler._dyn is None
+        finally:
+            srv.stop()
+
+    @staticmethod
+    def _flush_sizes(make_sched, n_entries):
+        """Drive n_entries concurrent lanes through a scheduler with
+        the deadline parked far away: only SIZE flushes can happen, so
+        the flush-size sequence IS the flush-boundary behavior."""
+        sizes = []
+        mtx = threading.Lock()
+
+        def counting(pks, msgs, sigs):
+            with mtx:
+                sizes.append(len(pks))
+            return ok_verify(pks, msgs, sigs)
+
+        sched = make_sched(counting)
+        sched.start()
+        try:
+            threads = [
+                threading.Thread(
+                    target=sched.verify,
+                    args=(b"\x01" * 32, b"m%d" % i, b"\x02" * 64),
+                )
+                for i in range(n_entries)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+        finally:
+            sched.stop()
+        return sizes
+
+    def test_off_parity_same_flush_boundaries_as_static(self):
+        """TENDERMINT_TPU_DYN_BATCH=off must reproduce the static
+        scheduler's flush boundaries exactly: same size-triggered
+        batch sequence for the same offered load."""
+        a = self._flush_sizes(
+            lambda fn: VerifyScheduler(fn, max_batch=8, max_delay=30.0), 24
+        )
+        b = self._flush_sizes(
+            lambda fn: VerifyScheduler(
+                fn, max_batch=8, max_delay=30.0, dyn_batch=False
+            ),
+            24,
+        )
+        assert a == b == [8, 8, 8]
+        static = VerifyScheduler(ok_verify, max_batch=8, max_delay=30.0)
+        off = VerifyScheduler(
+            ok_verify, max_batch=8, max_delay=30.0, dyn_batch=False
+        )
+        assert off._dyn is None  # no controller is constructed at all
+        assert static.resolved_knobs() == off.resolved_knobs()
+
+
+# --- mesh-aware max_batch staleness (ISSUE 17 satellite) --------------------
+
+
+class TestMeshAwareMaxBatch:
+    def test_max_batch_tracks_mesh_reconfigure(self, monkeypatch):
+        """Regression: a scheduler built BEFORE MeshManager.configure()
+        must not bake the pre-configuration device count into its
+        default max_batch forever."""
+        from tendermint_tpu.parallel import mesh
+
+        monkeypatch.setenv(mesh.MESH_ENV, "1")
+        mesh.manager.reset()
+        try:
+            s = VerifyScheduler(ok_verify)
+            assert s.max_batch == DEFAULT_MAX_BATCH
+            mesh.manager.configure(8)  # the real topology lands late
+            assert s.max_batch == DEFAULT_MAX_BATCH * 8
+        finally:
+            mesh.manager.reset()
+
+    def test_explicit_max_batch_wins_over_mesh(self, monkeypatch):
+        from tendermint_tpu.parallel import mesh
+
+        s = VerifyScheduler(ok_verify, max_batch=17)
+        mesh.manager.reset()
+        try:
+            assert s.max_batch == 17
+            s.max_batch = 5  # operator override sticks too
+            assert s.max_batch == 5
+        finally:
+            mesh.manager.reset()
+
+    def test_config_gen_bumps_on_configure_and_reset(self):
+        from tendermint_tpu.parallel import mesh
+
+        g0 = mesh.manager.config_gen()
+        mesh.manager.configure(1)
+        g1 = mesh.manager.config_gen()
+        assert g1 > g0
+        mesh.manager.reset()
+        assert mesh.manager.config_gen() > g1
+
+
+# --- per-tenant SLO budgets -------------------------------------------------
+
+
+class TestTenantSlo:
+    def test_breach_shed_recovery_synthetic_clock(self):
+        srv = VerifydServer(verify_fn=ok_verify)
+        try:
+            hot = srv._tenant_for("hot")
+            cold = srv._tenant_for("cold")
+            srv._tenant_declare_slo(hot, 10)  # 10ms p99 target
+            now = 100.0
+            # a cold sketch casts no verdicts
+            for _ in range(server_mod._SLO_MIN_SAMPLES):
+                srv._tenant_observe_latency(hot, 0.05, now)
+            assert srv.tenant_stats()["hot"]["slo_shedding"] is False
+            # sustained breach past the hysteresis window trips the gate
+            srv._tenant_observe_latency(
+                hot, 0.05, now + srv.slo_breach_after + 0.01
+            )
+            ten = srv.tenant_stats()["hot"]
+            assert ten["slo_shedding"] is True
+            t_shed = now + srv.slo_breach_after + 0.01
+            assert srv._tenant_slo_gate(hot, t_shed + 0.01) is True
+            # tenant-SCOPED: the other tenant is untouched
+            assert srv._tenant_slo_gate(cold, t_shed + 0.01) is False
+            assert srv.tenant_stats()["hot"]["slo_sheds"] == 1
+            # release after the recovery clock, with a fresh sketch
+            t_rec = t_shed + srv.slo_recover_after + 0.01
+            assert srv._tenant_slo_gate(hot, t_rec) is False
+            ten = srv.tenant_stats()["hot"]
+            assert ten["slo_shedding"] is False
+            assert ten["p99_ms"] == 0.0  # ring reset: fresh evidence only
+        finally:
+            srv.stop()
+
+    def test_wire_declaration_tightest_wins_operator_pins(self):
+        srv = VerifydServer(
+            verify_fn=ok_verify, tenant_slos={"pinned": 30}
+        )
+        try:
+            free = srv._tenant_for("free")
+            srv._tenant_declare_slo(free, 50)
+            assert srv.tenant_stats()["free"]["slo_ms"] == 50
+            srv._tenant_declare_slo(free, 20)  # tighter: adopted
+            assert srv.tenant_stats()["free"]["slo_ms"] == 20
+            srv._tenant_declare_slo(free, 90)  # laxer: ignored
+            assert srv.tenant_stats()["free"]["slo_ms"] == 20
+            pinned = srv._tenant_for("pinned")
+            srv._tenant_declare_slo(pinned, 1)  # operator pin wins
+            assert srv.tenant_stats()["pinned"]["slo_ms"] == 30
+        finally:
+            srv.stop()
+
+    def test_slo_shed_scoped_end_to_end(self):
+        """Breach -> scoped shed -> exemption, through the real wire:
+        the hot tenant's rpc is shed, its consensus is NOT, and the
+        quiet tenant never notices."""
+
+        def slow(pks, msgs, sigs):
+            time.sleep(0.02)
+            return [True] * len(pks)
+
+        srv = VerifydServer(
+            verify_fn=slow,
+            max_batch=4,
+            max_delay=0.001,
+            tenant_slos={"hot": 2},  # 2ms target vs a 20ms device
+            slo_breach_after=0.05,
+            slo_recover_after=60.0,  # no release during the test
+        )
+        srv.start()
+        try:
+            addr = "%s:%d" % srv.address
+            lanes = ([b"\x01" * 32], [b"slo"], [b"\x02" * 64])
+            hot = VerifydClient(
+                addr, tenant="hot", fallback=False, shed_retries=0
+            )
+            quiet = VerifydClient(
+                addr, tenant="quiet", fallback=False, shed_retries=0
+            )
+            shed = False
+            for _ in range(server_mod._SLO_MIN_SAMPLES + 40):
+                try:
+                    hot.verify(*lanes)  # rpc class by default
+                except VerifydRejectedError:
+                    shed = True
+                    break
+            assert shed, "hot tenant rpc was never SLO-shed"
+            assert srv.tenant_stats()["hot"]["slo_sheds"] >= 1
+            # consensus from the SAME tenant is exempt
+            from tendermint_tpu.verifyd import protocol
+
+            assert hot.verify(*lanes, klass=protocol.CLASS_CONSENSUS) == [
+                True
+            ]
+            # the quiet tenant is untouched by hot's brownout
+            assert quiet.verify(*lanes) == [True]
+            assert srv.tenant_stats()["quiet"]["slo_sheds"] == 0
+            hot.close()
+            quiet.close()
+        finally:
+            srv.stop()
+
+    def test_protocol_slo_field_roundtrip(self):
+        from tendermint_tpu.verifyd import protocol
+
+        req = protocol.VerifyRequest(
+            pks=[b"\x01" * 32], msgs=[b"m"], sigs=[b"\x02" * 64], slo_ms=75
+        )
+        enc = protocol.encode_request(req)
+        assert len(enc) == protocol.encoded_request_size(req)
+        assert protocol.decode_request(enc).slo_ms == 75
+        # zero is OMITTED on the wire and re-established on decode
+        req.slo_ms = 0
+        enc0 = protocol.encode_request(req)
+        assert len(enc0) < len(enc)
+        assert protocol.decode_request(enc0).slo_ms == 0
+        # bound: a nonsense declaration is rejected at decode
+        req.slo_ms = protocol.MAX_SLO_MS + 1
+        with pytest.raises(ValueError):
+            protocol.decode_request(protocol.encode_request(req))

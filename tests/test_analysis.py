@@ -700,6 +700,123 @@ class TestWireCompat:
         assert self.run(src) == []
 
 
+# ISSUE 17: the slo_ms wire field — a zero-omitted PLAIN varint (no enum
+# family) whose decode path must pin the integer zero, or an old frame
+# without the field decodes differently from a new frame carrying an
+# explicit 0.
+SLO_VARINT_DIRTY = """
+    def encode_varint_field(field, v):
+        return b""
+
+    def encode(req):
+        out = b""
+        if req.slo_ms:
+            out += encode_varint_field(8, req.slo_ms)
+        return out
+
+    def decode(r, req):
+        req.slo_ms = r.read_varint()
+        return req
+"""
+
+SLO_VARINT_CLEAN = """
+    def encode_varint_field(field, v):
+        return b""
+
+    def encode(req):
+        out = b""
+        if req.slo_ms:
+            out += encode_varint_field(8, req.slo_ms)
+        return out
+
+    def decode(r, req):
+        req.slo_ms = r.read_varint()
+        req.slo_ms = req.slo_ms or 0
+        return req
+"""
+
+
+class TestWireVarintZeroOmission:
+    def run(self, src):
+        return run_on(
+            WireCompatChecker(), {"tendermint_tpu/verifyd/protocol.py": src}
+        )
+
+    def test_flags_varint_without_zero_reestablishment(self):
+        found = self.run(SLO_VARINT_DIRTY)
+        assert codes(found) == ["TPW004"]
+        assert "slo_ms" in found[0].message
+        assert "zero" in found[0].message
+
+    def test_or_zero_twin_passes(self):
+        assert self.run(SLO_VARINT_CLEAN) == []
+
+    def test_zero_dataclass_default_passes(self):
+        src = """
+            class VerifyRequest:
+                slo_ms: int = 0
+
+            def encode_varint_field(field, v):
+                return b""
+
+            def encode(req):
+                out = b""
+                if req.slo_ms:
+                    out += encode_varint_field(8, req.slo_ms)
+                return out
+        """
+        assert self.run(src) == []
+
+    def test_enum_family_fields_stay_tpw001_territory(self):
+        # a field WITH an enum family is TPW001's beat even when emitted
+        # via encode_varint_field: the dataclass AnnAssign default names
+        # the 0-member, so the zero-omission round-trip is safe and the
+        # varint leg must not double-report it
+        src = """
+            KIND_RAW = 0
+            KIND_COMMIT = 1
+            KIND_NAMES = {KIND_RAW: "raw", KIND_COMMIT: "commit"}
+
+            class VerifyRequest:
+                kind: int = KIND_RAW
+
+            def encode_varint_field(field, v):
+                return b""
+
+            def encode(req):
+                out = b""
+                if req.kind:
+                    out += encode_varint_field(1, req.kind)
+                return out
+        """
+        assert self.run(src) == []
+
+    def test_enum_family_via_field_emitter_still_catches_tpw001(self):
+        # the dirty twin: same emit through encode_varint_field, but the
+        # decode default is NOT the 0-member — the original consensus
+        # priority bug, now visible through the field-level emitter
+        src = """
+            KIND_RAW = 0
+            KIND_COMMIT = 1
+            KIND_NAMES = {KIND_RAW: "raw", KIND_COMMIT: "commit"}
+
+            class VerifyRequest:
+                kind: int = KIND_COMMIT
+
+            def encode_varint_field(field, v):
+                return b""
+
+            def encode(req):
+                out = b""
+                if req.kind:
+                    out += encode_varint_field(1, req.kind)
+                return out
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPW001"]
+        assert "KIND_RAW" in found[0].message
+
+
 SLAB_DIRTY = """
     SLAB_OFF_GEN = 0
     SLAB_OFF_KLASS = 8

@@ -491,7 +491,11 @@ SERVER_SCRIPT = textwrap.dedent(
         return [True] * len(pks)
 
     srv = VerifydServer(
-        verify_fn=modeled, max_batch=64, max_delay=0.001, shm=shm_mode
+        # static batching: the acceptance measures the stage vector
+        # tiling a fixed config's wall; the dyn controller shortening
+        # residency deflates the wall the transport gap is judged against
+        verify_fn=modeled, max_batch=64, max_delay=0.001, shm=shm_mode,
+        dyn_batch=False,
     )
     srv.start()
     print("ADDR %s:%d" % srv.address, flush=True)

@@ -62,6 +62,7 @@ class TestSlabHeader:
             buf, 0, gen=4, kind=protocol.KIND_COMMIT,
             klass=protocol.CLASS_LIGHT, deadline_ms=250,
             algo=protocol.ALGO_SR25519, lanes=17, tenant="chain-a",
+            slo_ms=75,
         )
         hdr = shm.unpack_header(buf, 0)
         assert hdr == {
@@ -69,7 +70,25 @@ class TestSlabHeader:
             "klass": protocol.CLASS_LIGHT, "deadline_ms": 250,
             "algo": protocol.ALGO_SR25519, "lanes": 17, "tenant": "chain-a",
             "trace": b"",  # omitted context decodes to the empty default
+            "slo_ms": 75,
         }
+
+    def test_omitted_slo_decodes_to_zero(self):
+        """A zeroed/old header carries no SLO — same zero-omission
+        default as the omitted protocol field 8, and slab reuse must
+        not leak the previous occupant's target."""
+        buf = self._buf()
+        shm.pack_header(
+            buf, 0, gen=2, kind=protocol.KIND_RAW,
+            klass=protocol.CLASS_RPC, deadline_ms=0,
+            algo=protocol.ALGO_ED25519, lanes=1, slo_ms=75,
+        )
+        shm.pack_header(
+            buf, 0, gen=4, kind=protocol.KIND_RAW,
+            klass=protocol.CLASS_RPC, deadline_ms=0,
+            algo=protocol.ALGO_ED25519, lanes=1,
+        )
+        assert shm.unpack_header(buf, 0)["slo_ms"] == 0
 
     def test_consensus_class_zero_survives(self):
         """CLASS_CONSENSUS is 0; it rides the slab +1 so a zeroed word
@@ -137,6 +156,7 @@ class TestSlabHeader:
             (shm.SLAB_OFF_ALGO, 99),
             (shm.SLAB_OFF_LANES, shm.SHM_MAX_LANES + 1),
             (shm.SLAB_OFF_TENANT_LEN, protocol.MAX_TENANT_LEN + 1),
+            (shm.SLAB_OFF_SLO_MS, protocol.MAX_SLO_MS + 1),
         ):
             buf = self._buf()
             shm.pack_header(
