@@ -51,8 +51,15 @@ def child_main(name: str) -> int:
         beat("start (no jax)")
 
     from tendermint_tpu.libs import flightrec, tracing
+    from tendermint_tpu.ops import introspect
 
     tracing.configure()
+    # Continuous kernel profiler (ops/introspect.py): on by default,
+    # TENDERMINT_TPU_PROFILE=off for the overhead-control runs the CI
+    # stage compares against. The digests ride the tracer's profile
+    # sink, so reported section numbers never include digesting time —
+    # same instrumentation-stripping rule as tpusan.
+    introspect.install()
     # Post-mortem ring: a child that dies on an unhandled exception or
     # SIGTERM dumps its last seconds into the run's shared dump dir
     # (DIR_ENV inherited from the parent); the runner references every
@@ -70,6 +77,11 @@ def child_main(name: str) -> int:
         from tendermint_tpu.crypto.scheduler import resolved_default_knobs
 
         fragment.setdefault("scheduler_knobs", resolved_default_knobs())
+        # Per-section kernel/compile profile digests (ISSUE 18): what
+        # the device actually spent per (engine, batch bucket) while
+        # this section ran. Off-profiler runs still get the fragment
+        # (enabled:false, empty digests) so schema diffs stay aligned.
+        fragment.setdefault("profile", introspect.profiler.snapshot())
 
     beat("done")
     print(json.dumps({"section": name, "fragment": fragment}), flush=True)

@@ -503,10 +503,41 @@ def collect_flightrec(doc: dict, partial_path: Optional[str]) -> None:
             results.write_partial(doc, partial_path)
 
 
+def diff_against_baseline(merged: dict, baseline_path: str) -> Optional[dict]:
+    """bench.py --baseline: after the merge, diff this round against a
+    prior BENCH JSON with scripts/bench_diff (the regression sentinel),
+    print the verdict table to stderr, append the one-line verdict to
+    the probe log, and attach the structured result to the merged doc.
+    Never changes the bench exit code — a regression verdict is
+    evidence, the sentinel's own CLI is the gate."""
+    from scripts import bench_diff
+
+    try:
+        with open(baseline_path) as f:
+            base = bench_diff.normalize(json.load(f), baseline_path)
+    except (OSError, ValueError) as exc:
+        _say("baseline diff skipped: %s" % exc)
+        return None
+    tol = bench_diff.default_tolerance()
+    rows = bench_diff.diff_sections(base, bench_diff.normalize(merged, "run"), tol)
+    print(bench_diff.render_table(rows, tol), file=sys.stderr)
+    line = bench_diff.verdict_line(baseline_path, "this-round", rows, tol)
+    log_probe(line)
+    return {
+        "baseline": baseline_path,
+        "tolerance_pct": tol,
+        "summary": bench_diff.summarize(rows),
+        "regressions": [
+            r for r in rows if r["verdict"] == bench_diff.REGRESSION
+        ],
+    }
+
+
 def run(
     plan: Optional[Tuple[str, ...]] = None,
     resume_path: Optional[str] = None,
     partial_path: Optional[str] = None,
+    baseline_path: Optional[str] = None,
 ) -> Tuple[dict, int]:
     """Full orchestration; returns (merged_doc, exit_code)."""
     from tendermint_tpu.libs import flightrec, tracing
@@ -568,6 +599,10 @@ def run(
             merged.get("impl"),
         )
     )
+    if baseline_path:
+        diff = diff_against_baseline(merged, baseline_path)
+        if diff is not None:
+            merged["baseline_diff"] = diff
     _say("done: %s (exit %d); partial at %s" % (summary, code, partial_path))
     return merged, code
 
@@ -583,6 +618,8 @@ bench.py — relay-resilient section benchmark runner
   python bench.py                      run every registered section
   python bench.py --sections a,b       run an explicit subset
   python bench.py --resume PATH        re-run only failed/missing sections
+  python bench.py --baseline PATH      diff this round against a prior
+                                       BENCH JSON after merge (sentinel)
   python bench.py --list-sections      show the registry and exit
   python bench.py --impl=mxu|xla|pallas|auto   pin the verifier impl
 
@@ -596,6 +633,7 @@ def cli(argv: List[str]) -> int:
     resume_path = None
     plan: Optional[Tuple[str, ...]] = None
     partial_path = None
+    baseline_path = None
     args = list(argv)
     i = 0
     while i < len(args):
@@ -629,6 +667,9 @@ def cli(argv: List[str]) -> int:
         elif arg == "--partial":
             partial_path = args[i + 1]
             i += 1
+        elif arg == "--baseline":
+            baseline_path = args[i + 1]
+            i += 1
         elif arg == "--list-sections":
             for name in sections.ORDER:
                 s = sections.get(name)
@@ -654,6 +695,11 @@ def cli(argv: List[str]) -> int:
             return 2
         i += 1
 
-    merged, code = run(plan=plan, resume_path=resume_path, partial_path=partial_path)
+    merged, code = run(
+        plan=plan,
+        resume_path=resume_path,
+        partial_path=partial_path,
+        baseline_path=baseline_path,
+    )
     print(json.dumps(merged))
     return code

@@ -15,6 +15,13 @@
   whose instrument was renamed away (or points at a counter/gauge,
   where exemplars silently never render) is the reverse failure and
   would otherwise ship dead trace-ID links.
+- TPM004 — bucket-label cardinality: every ``.labels(bucket=...)``
+  call site must pass a value produced by
+  ``ops/introspect.bucket_label`` (directly, or via a local name
+  assigned from it in the same function). That helper is the ONE
+  place batch sizes collapse to power-of-two buckets with an
+  ``other`` overflow; a raw ``bucket=str(n)`` call site would mint a
+  label value per distinct batch size and blow up every scrape.
 
 This is a project-level checker (it needs the whole package to find
 references), which is exactly why ``check_metrics.py`` could not stay a
@@ -142,6 +149,67 @@ def exemplar_findings(
                 )
 
 
+def _is_bucket_label_call(node: ast.AST) -> bool:
+    """A direct ``bucket_label(...)`` / ``introspect.bucket_label(...)``
+    call expression."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "bucket_label"
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "bucket_label"
+    return False
+
+
+def _blessed_bucket_names(fn: ast.AST) -> Set[str]:
+    """Local names assigned (anywhere in this function) from a
+    bucket_label call — the value is bounded no matter which branch
+    assigned it."""
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _is_bucket_label_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+def bucket_findings(project: Project) -> Iterator[Finding]:
+    """TPM004: bounded bucket labels (see module docstring)."""
+    for mod in project.modules:
+        if not mod.rel.startswith("tendermint_tpu/"):
+            continue
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            blessed = None  # computed lazily: most functions have no sites
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "labels"
+                ):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "bucket":
+                        continue
+                    if _is_bucket_label_call(kw.value):
+                        continue
+                    if blessed is None:
+                        blessed = _blessed_bucket_names(fn)
+                    if isinstance(kw.value, ast.Name) and kw.value.id in blessed:
+                        continue
+                    yield Finding(
+                        mod.rel,
+                        node.lineno,
+                        "TPM004",
+                        "bucket= label value does not come from "
+                        "introspect.bucket_label — unbounded label "
+                        "cardinality (one value per distinct batch size)",
+                    )
+
+
 def name_findings(module: Module) -> Iterator[Finding]:
     namespace = "tendermint"
     for node in ast.walk(module.tree):
@@ -231,9 +299,12 @@ class MetricsChecker(Checker):
         "TPM002": "metric exposition-name hygiene violation",
         "TPM003": "exemplar bound to an undeclared or non-histogram "
         "instrument",
+        "TPM004": "bucket label value not routed through "
+        "introspect.bucket_label (unbounded cardinality)",
     }
 
     def check_project(self, project: Project) -> Iterator[Finding]:
+        yield from bucket_findings(project)
         metrics_mod = project.module(METRICS_REL)
         if metrics_mod is None:
             return

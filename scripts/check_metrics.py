@@ -83,6 +83,16 @@ def find_exemplar_problems() -> list:
     ]
 
 
+def find_bucket_problems() -> list:
+    """TPM004 findings as strings: ``.labels(bucket=...)`` call sites
+    whose value does not route through introspect.bucket_label."""
+    modules = load_modules([PACKAGE], repo_root=REPO)
+    return [
+        f"{f.path}:{f.line}: {f.message}"
+        for f in _mc.bucket_findings(Project(modules))
+    ]
+
+
 def main() -> int:
     decls = declared_instruments()
     dead = find_dead_instruments()
@@ -104,11 +114,16 @@ def main() -> int:
     for problem in exemplar_problems:
         print(f"EXEMPLAR BINDING {problem}", file=sys.stderr)
         rc = 1
+    bucket_problems = find_bucket_problems()
+    for problem in bucket_problems:
+        print(f"BUCKET CARDINALITY {problem}", file=sys.stderr)
+        rc = 1
     if rc == 0:
         print(
             f"ok: all {len(decls)} declared instruments are referenced;"
             f" {len(hygiene['names'])} exposition names unique and"
-            f" well-formed; exemplar-bearing histograms bound"
+            f" well-formed; exemplar-bearing histograms bound;"
+            f" bucket labels bounded"
         )
     return rc
 

@@ -463,6 +463,77 @@ print(
 )
 EOF
 
+echo "== introspection: sanitized suites + sentinel + profiler overhead =="
+# ISSUE 18 stage. (a) The device-byte accountant and profiler digests
+# run under happens-before race detection — the ledger is written from
+# resident-store refresh, shm register/unregister, and compile paths
+# concurrently, so a missing lock is a real race. (b) The bench_diff
+# sentinel's documented acceptance pair: r01 -> r05 shows the relay
+# throughput collapse and MUST exit 4 (regression); the identity diff
+# MUST exit 0. (c) Profiler overhead: the host_ref throughput section
+# with the profiler on must land within 5% of a profiler-off run, and
+# the merged JSON must carry the profile fragment.
+rm -f /tmp/_tpusan_introspect.log
+timeout -k 10 600 env TENDERMINT_TPU_SANITIZE=hb JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_introspect.py tests/test_bench_diff.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+    | tee /tmp/_tpusan_introspect.log
+[ "${PIPESTATUS[0]}" -ne 0 ] && rc_total=1
+if grep -q "DATA RACE" /tmp/_tpusan_introspect.log; then
+    echo "introspect: data race detected (stacks above)" >&2
+    rc_total=1
+fi
+python -m scripts.bench_diff BENCH_r01.json BENCH_r05.json \
+    > /tmp/_bench_diff_accept.log 2>&1
+if [ "$?" -ne 4 ]; then
+    echo "bench_diff acceptance: r01 -> r05 must exit 4 (regression)" >&2
+    rc_total=1
+fi
+python -m scripts.bench_diff BENCH_r05.json BENCH_r05.json >/dev/null \
+    || { echo "bench_diff acceptance: identity diff must exit 0" >&2; \
+         rc_total=1; }
+rm -rf /tmp/_bench_prof && mkdir -p /tmp/_bench_prof
+for prof in on off; do
+    timeout -k 10 180 env JAX_PLATFORMS=cpu TENDERMINT_TPU_PROFILE=$prof \
+        BENCH_SECTIONS=host_ref BENCH_HOST_REF_SIGS=64 \
+        BENCH_SECTION_TIMEOUT=150 BENCH_SECTION_ATTEMPTS=1 \
+        BENCH_PARTIAL=/tmp/_bench_prof/partial_$prof.json \
+        python bench.py > /tmp/_bench_prof/out_$prof.json \
+        2>/tmp/_bench_prof/err_$prof.log || {
+        echo "bench profiler smoke ($prof): non-zero rc" >&2
+        tail -5 /tmp/_bench_prof/err_$prof.log >&2
+        rc_total=1
+    }
+done
+python - <<'EOF' || rc_total=1
+import json
+on = json.load(open("/tmp/_bench_prof/out_on.json"))
+off = json.load(open("/tmp/_bench_prof/out_off.json"))
+# the profile fragment rides in every merged doc; its enabled flag
+# reflects the knob
+assert on["profile"]["enabled"] is True, on.get("profile")
+assert off["profile"]["enabled"] is False, off.get("profile")
+t_on = on["host_ref"]["sigs_per_s"]
+t_off = off["host_ref"]["sigs_per_s"]
+overhead = (t_off - t_on) / t_off * 100.0
+assert overhead <= 5.0, (
+    "profiler overhead %.1f%% exceeds the 5%% budget "
+    "(%.1f sigs/s on vs %.1f off)" % (overhead, t_on, t_off)
+)
+print(
+    "profiler overhead ok: %.1f sigs/s on vs %.1f off (%.1f%%)"
+    % (t_on, t_off, overhead)
+)
+EOF
+# smoke diff against the checked-in CPU fingerprint: the generous
+# tolerance absorbs hardware variance; what it still catches is an
+# order-of-magnitude collapse or a section/metric falling out of the
+# merged doc entirely (--strict-missing)
+python -m scripts.bench_diff --tolerance 75 --strict-missing \
+    BENCH_cpu_smoke_baseline.json /tmp/_bench_prof/out_on.json \
+    || { echo "introspect: smoke diff vs checked-in fingerprint failed" >&2; \
+         rc_total=1; }
+
 echo "== tier-1 pytest =="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -105,10 +105,42 @@ def _skew_corrections(docs: List[dict], shifted: List[List[dict]]) -> List[float
     return corrections
 
 
+def _unusable_reason(doc: dict) -> Optional[str]:
+    """A ring export that cannot land on the shared time base: no
+    complete spans (an idle process's drained ring — nothing to merge)
+    or no ``epoch_unix_us`` anchor (a pre-PR-15 export, or a hand-cut
+    fixture — base-aligning it at 0 would scatter its events millions
+    of seconds from the fleet). Such docs are SKIPPED with a warning
+    rather than silently misaligned or fatally rejected: one stale
+    export must not cost the rest of the fleet its timeline."""
+    if not any(ev.get("ph") == "X" for ev in _events(doc)):
+        return "no complete spans"
+    other = doc.get("otherData") or {}
+    try:
+        float(other["epoch_unix_us"])
+    except (KeyError, TypeError, ValueError):
+        return "missing otherData.epoch_unix_us anchor"
+    return None
+
+
 def merge(docs: List[dict]) -> dict:
-    """Merge N per-process export documents into one timeline dict."""
+    """Merge N per-process export documents into one timeline dict.
+    Unusable exports (zero spans / missing epoch anchor) are skipped
+    with a stderr warning and counted in ``otherData.skipped``."""
+    usable: List[dict] = []
+    skipped = 0
+    for n, doc in enumerate(docs):
+        reason = _unusable_reason(doc)
+        if reason is not None:
+            skipped += 1
+            print(
+                "trace_merge: skipping export #%d: %s" % (n, reason),
+                file=sys.stderr,
+            )
+            continue
+        usable.append(doc)
     shifted: List[List[dict]] = []
-    for doc in docs:
+    for doc in usable:
         base = _epoch_us(doc)
         evs = []
         for ev in _events(doc):
@@ -117,7 +149,7 @@ def merge(docs: List[dict]) -> dict:
                 ev["ts"] = ev["ts"] + base
             evs.append(ev)
         shifted.append(evs)
-    corrections = _skew_corrections(docs, shifted)
+    corrections = _skew_corrections(usable, shifted)
     merged: List[dict] = []
     for i, evs in enumerate(shifted):
         corr = corrections[i]
@@ -131,7 +163,8 @@ def merge(docs: List[dict]) -> dict:
         "displayTimeUnit": "ms",
         "otherData": {
             "schema": MERGED_SCHEMA,
-            "merged_from": len(docs),
+            "merged_from": len(usable),
+            "skipped": skipped,
             "skew_corrections_us": corrections,
         },
     }

@@ -582,6 +582,12 @@ def cmd_verifyd(args) -> int:
     from tendermint_tpu.libs import flightrec
 
     flightrec.install()
+    # continuous kernel profiler + device-byte ledger (ops/introspect):
+    # the serving tier's dispatch spans feed the per-bucket digests, and
+    # the --metrics RPC server also answers GET /debug/memstats
+    from tendermint_tpu.ops import introspect
+
+    introspect.install()
     server.start()
     if metrics_server is not None:
         metrics_server.start()

@@ -240,6 +240,7 @@ class FlightRecorder:
             ),
             "window_s": window_s if window_s is not None else self.window_s,
             "records": records,
+            "memstats": self._memstats_section(),
         }
         tmp = "%s.tmp.%d" % (path, os.getpid())
         try:
@@ -257,6 +258,21 @@ class FlightRecorder:
         with self._mtx:
             self._last_dump_path = path
         return path
+
+    def _memstats_section(self) -> Dict[str, Any]:
+        """Device-tier snapshot for the dump (ISSUE 18): the introspect
+        ledger + profiler digests, SIZE-BOUNDED to a quarter of the ring
+        budget (64 KiB cap) so the new section can never push an atomic
+        dump meaningfully past what the ring itself was allowed to hold
+        — introspect degrades the payload (drop profile digests, then
+        collapse to totals) rather than let one dump grow unbounded."""
+        try:
+            from tendermint_tpu.ops import introspect
+
+            limit = min(self.cap_bytes // 4, 64 * 1024)
+            return json.loads(introspect.memstats_json(limit_bytes=limit))
+        except Exception:
+            return {}  # the post-mortem dump must not fail on accounting
 
     def last_dump_path(self) -> Optional[str]:
         with self._mtx:

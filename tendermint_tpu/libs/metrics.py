@@ -576,6 +576,33 @@ class OpsMetrics(_NopMixin):
             "Signature lanes currently dispatched to the device.",
             labels=("engine",),
         )
+        # Device-tier introspection (ops/introspect.py). owner values
+        # are a closed set (resident_tables, shm_slabs) plus
+        # resident_tables/<tenant>, whose tenant names are already
+        # sanitized+capped by verifyd admission; bucket labels come
+        # exclusively from introspect.bucket_label (power-of-two,
+        # "other" overflow — tpulint TPM004 audits every call site), so
+        # all three families are cardinality-bounded by construction.
+        self.device_bytes = reg.gauge(
+            _name(s, "device_bytes"),
+            "Device-resident bytes currently held, by owner.",
+            labels=("owner",),
+        )
+        self.compile_events = reg.counter(
+            _name(s, "compile_events_total"),
+            "XLA kernel (re)compilations observed, by engine.",
+            labels=("engine",),
+        )
+        self.kernel_bucket_seconds = reg.histogram(
+            _name(s, "kernel_bucket_seconds"),
+            "Kernel dispatch wall time by engine and power-of-two"
+            " batch bucket (continuous profiler).",
+            labels=("engine", "bucket"),
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+            ),
+        )
 
 
 class VerifydMetrics(_NopMixin):

@@ -248,6 +248,9 @@ class Tracer:
         # flight-recorder sink: (kind, name, args, ts_s, dur_s) for every
         # completed span / instant, read racily like _observer
         self._flight: Optional[Callable[[str, str, Dict[str, Any], float, float], None]] = None  # guarded-by: none(racy hot-path read)
+        # third sink slot: the kernel profiler (ops/introspect.py), fed
+        # (name, args, seconds) like _observer, read racily like it
+        self._profile: Optional[Callable[[str, Dict[str, Any], float], None]] = None  # guarded-by: none(racy hot-path read)
         self._epoch = time.perf_counter()
         self._pid = os.getpid()
         self._thread_names: Dict[int, str] = {}  # guarded-by: _lock
@@ -308,6 +311,16 @@ class Tracer:
         with self._lock:
             self._flight = sink
 
+    def set_profile_sink(
+        self, sink: Optional[Callable[[str, Dict[str, Any], float], None]]
+    ) -> None:
+        """Single profiler slot (ops/introspect installs itself here):
+        called with (name, args, seconds) for every completed span, even
+        in ``off`` mode, so the rolling kernel/compile digests stay live
+        when the ring is not kept. None uninstalls (profiler off)."""
+        with self._lock:
+            self._profile = sink
+
     # --- recording -----------------------------------------------------------
 
     def _stack(self) -> List[Any]:
@@ -325,7 +338,12 @@ class Tracer:
         """``with tracer.span("prep_chunk", lane_count=n):`` — nested
         spans inherit this one as parent (per-thread). ``parent_ctx``
         splices the span under a remote caller's context instead."""
-        if not self._recording and self._observer is None and self._flight is None:
+        if (
+            not self._recording
+            and self._observer is None
+            and self._flight is None
+            and self._profile is None
+        ):
             return NOP_SPAN
         return _Span(self, name, args, remote=parent_ctx)
 
@@ -396,6 +414,12 @@ class Tracer:
                 observer(span.name, span.args, duration)
             except Exception:
                 pass  # a broken metrics binding must not fail the traced op
+        profile = self._profile
+        if profile is not None:
+            try:
+                profile(span.name, span.args, duration)
+            except Exception:
+                pass  # a broken profiler must not fail the traced op
         flight = self._flight
         if flight is not None:
             try:

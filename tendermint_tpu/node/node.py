@@ -341,6 +341,13 @@ class Node:
 
         _mesh.manager.configure(config.mesh_devices)
         _mesh.manager.bind_metrics(ops_metrics)
+        # Device-tier introspection (ops/introspect.py): mirror the
+        # byte ledger + compile counters into this registry and install
+        # the continuous kernel profiler as the tracer's profile sink.
+        from tendermint_tpu.ops import introspect as _introspect
+
+        _introspect.bind_metrics(ops_metrics)
+        _introspect.install()
         # Span tracer: honor an explicit config knob (env otherwise), and
         # feed span durations into the stage/step histograms regardless of
         # whether the ring is recording.

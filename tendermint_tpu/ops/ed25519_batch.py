@@ -398,7 +398,11 @@ def _compiled_kernel(n: int, backend: Optional[str], mul_impl: str = "vpu"):
         with field.pinned_mul_impl(mul_impl):
             return verify_kernel(pk, r, s, k)
 
-    return jax.jit(run, backend=backend)
+    from tendermint_tpu.ops import introspect
+
+    return introspect.traced_first_call(
+        jax.jit(run, backend=backend), "ed25519", "verify", n
+    )
 
 
 @lru_cache(maxsize=16)
@@ -410,7 +414,11 @@ def _compiled_kernel_tables(n: int, backend: Optional[str], mul_impl: str = "vpu
         with field.pinned_mul_impl(mul_impl):
             return verify_kernel_tables(tab, ok, r, s, k)
 
-    return jax.jit(run, backend=backend)
+    from tendermint_tpu.ops import introspect
+
+    return introspect.traced_first_call(
+        jax.jit(run, backend=backend), "ed25519", "verify_tables", n
+    )
 
 
 @lru_cache(maxsize=16)
@@ -422,7 +430,11 @@ def _compiled_kernel_resident(n: int, backend: Optional[str], mul_impl: str = "v
         with field.pinned_mul_impl(mul_impl):
             return verify_kernel_resident(tab_store, idx, ok, r, s, k)
 
-    return jax.jit(run, backend=backend)
+    from tendermint_tpu.ops import introspect
+
+    return introspect.traced_first_call(
+        jax.jit(run, backend=backend), "ed25519", "verify_resident", n
+    )
 
 
 # --- implementation dispatch (XLA graph vs Pallas kernel) -------------------

@@ -661,8 +661,17 @@ def _trace_first_call(fn, kernel: str, n: int):
         nonlocal compiled
         if not compiled:
             compiled = True
+            from tendermint_tpu.ops import introspect
+
+            introspect.note_compile("pallas")
+            # engine= keys the profiler's compile digests; impl= stays
+            # for trace readers that predate it
             with tracing.span(
-                "kernel_compile", kernel=kernel, lanes=n, impl="pallas"
+                "kernel_compile",
+                engine="pallas",
+                kernel=kernel,
+                lanes=n,
+                impl="pallas",
             ):
                 return fn(*args)
         return fn(*args)

@@ -176,8 +176,17 @@ class ResidentTableStore:
             self.uploads += 1
             self.h2d_bytes += nbytes
             metrics = self._metrics
+            pins = dict(self._tenant_pins)
         if metrics is not None:
             metrics.table_h2d_bytes.inc(nbytes)
+        # Device-tier ledger (ops/introspect.py): the installed tensor
+        # is THE resident_tables allocation — absolute-set keeps the
+        # ledger exact across rotation (drop zeroes it, the re-upload
+        # sets the new size).
+        from tendermint_tpu.ops import introspect
+
+        introspect.set_bytes("resident_tables", nbytes)
+        introspect.accountant.set_tenant_bytes(nbytes, pins)
         return True
 
     @staticmethod
@@ -220,6 +229,11 @@ class ResidentTableStore:
         self._mesh_key = None
         self._backend_key = None
         self._version += 1
+        # the introspect ledger holds its own (leaf) lock, never ours
+        from tendermint_tpu.ops import introspect
+
+        introspect.set_bytes("resident_tables", 0)
+        introspect.accountant.set_tenant_bytes(0, {})
 
     # --- lookup -------------------------------------------------------------
 

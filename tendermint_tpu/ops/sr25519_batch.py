@@ -159,7 +159,11 @@ def _compiled_kernel_sr(n: int, backend: Optional[str], mul_impl: str = "vpu"):
         with field.pinned_mul_impl(mul_impl):
             return verify_kernel_sr(pk, r, s, k)
 
-    return jax.jit(run, backend=backend)
+    from tendermint_tpu.ops import introspect
+
+    return introspect.traced_first_call(
+        jax.jit(run, backend=backend), "sr25519", "verify_sr", n
+    )
 
 
 # --- host-side preparation --------------------------------------------------

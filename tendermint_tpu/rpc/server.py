@@ -442,6 +442,13 @@ class RPCServer:
                 )
             )
             return 200, "application/json", body
+        if method == "debug/memstats":
+            # Device-tier snapshot (ops/introspect.py): resident-table /
+            # slab-ring bytes by owner, compile events, exec-cache
+            # entries, and the rolling kernel-profile digests.
+            from tendermint_tpu.ops import introspect
+
+            return 200, "application/json", introspect.memstats_json().encode()
         if method == "metrics" and self.metrics_registry is not None:
             return (
                 200,

@@ -327,6 +327,25 @@ class _TenantState:
 # --- admission ---------------------------------------------------------------
 
 
+def _introspect_bytes() -> Dict[str, int]:
+    """Device-byte ledger for stats(); never fails the stats call."""
+    try:
+        from tendermint_tpu.ops import introspect
+
+        return introspect.accountant.snapshot()["device_bytes"]
+    except Exception:
+        return {}
+
+
+def _introspect_compiles() -> Dict[str, int]:
+    try:
+        from tendermint_tpu.ops import introspect
+
+        return introspect.accountant.snapshot()["compile_events"]
+    except Exception:
+        return {}
+
+
 def _default_sr25519_verify(pks, msgs, sigs) -> List[bool]:
     """Tiered sr25519 dispatch, mirroring the ed25519 policy."""
     if len(pks) < crypto_batch.DEVICE_THRESHOLD:
@@ -646,6 +665,11 @@ class VerifydServer:
                 "shm_fallbacks": self.shm_fallbacks,
                 "shm_sessions": ep.session_count() if ep is not None else 0,
                 "scheduler": knobs,
+                # device-tier ledger (ops/introspect.py): resident /
+                # slab bytes by owner + compile counters, so `verifyd
+                # stats` answers "what is sitting on the device" too
+                "device_bytes": _introspect_bytes(),
+                "compile_events": _introspect_compiles(),
             }
 
     def tenant_stats(self) -> Dict[str, Dict[str, int]]:

@@ -994,6 +994,12 @@ class ShmEndpoint:
             except OSError:
                 pass  # socket path already removed: best-effort
         for s in sessions:
+            # sessions were drained from the registry above without
+            # passing through unregister: settle their ledger bytes
+            # here or the shm_slabs owner would leak across restarts
+            from tendermint_tpu.ops import introspect
+
+            introspect.add_bytes("shm_slabs", -s._seg.size)
             s.close()
         self.occupancy_changed()
 
@@ -1002,10 +1008,19 @@ class ShmEndpoint:
     def register(self, session: _ShmSession) -> None:
         with self._mtx:
             self._sessions[id(session)] = session
+        # device-tier ledger (ops/introspect.py): the mapped slab ring
+        # is resident memory held on this client's behalf
+        from tendermint_tpu.ops import introspect
+
+        introspect.add_bytes("shm_slabs", session._seg.size)
 
     def unregister(self, session: _ShmSession) -> None:
         with self._mtx:
-            self._sessions.pop(id(session), None)
+            popped = self._sessions.pop(id(session), None)
+        if popped is not None:
+            from tendermint_tpu.ops import introspect
+
+            introspect.add_bytes("shm_slabs", -popped._seg.size)
         self.occupancy_changed()
 
     def session_count(self) -> int:
@@ -1099,6 +1114,9 @@ class ShmClientTransport:
                 raise
             raise ShmAttachError(f"attach failed: {exc}") from exc
         sock.settimeout(None)
+        from tendermint_tpu.ops import introspect
+
+        introspect.add_bytes("shm_slabs/client", seg.size)
         self._seg = seg
         self._ring = ring
         self._sock = sock
@@ -1290,6 +1308,9 @@ class ShmClientTransport:
         except OSError:
             pass  # _fail may have closed it first; either's is fine
         self._reader.join(timeout=2.0)
+        from tendermint_tpu.ops import introspect
+
+        introspect.add_bytes("shm_slabs/client", -self._seg.size)
         _close_quiet(self._seg)
         _unlink_quiet(self._seg)
 

@@ -1120,6 +1120,63 @@ class TestMetricsChecks:
         )
         assert found == []
 
+    def test_raw_bucket_label_flagged(self):
+        # TPM004: a raw str(n) mints one label value per batch size
+        user_src = """
+            def f(m, n):
+                m.hits.inc()
+                m.lat.labels(engine="ed25519", bucket=str(n)).observe(0.1)
+        """
+        found = run_on(
+            MetricsChecker(),
+            {
+                METRICS_REL: self.EXEMPLAR_METRICS,
+                "tendermint_tpu/ops/u.py": user_src,
+            },
+        )
+        assert codes(found) == ["TPM004"]
+        assert "bucket_label" in found[0].message
+
+    def test_bucket_label_routed_passes(self):
+        # direct call and a local name assigned from it are both blessed
+        user_src = """
+            from tendermint_tpu.ops.introspect import bucket_label
+
+            def direct(m, n):
+                m.hits.inc()
+                m.lat.labels(bucket=bucket_label(n)).observe(0.1)
+
+            def via_local(m, introspect, n):
+                bucket = introspect.bucket_label(n)
+                m.lat.labels(engine="sr25519", bucket=bucket).observe(0.2)
+        """
+        found = run_on(
+            MetricsChecker(),
+            {
+                METRICS_REL: self.EXEMPLAR_METRICS,
+                "tendermint_tpu/ops/u.py": user_src,
+            },
+        )
+        assert found == []
+
+    def test_bucket_outside_package_ignored(self):
+        # the cardinality rule is about the package's exposition; bench
+        # helpers and scripts can label however they like
+        user_src = """
+            def f(m, n):
+                m.hits.inc()
+                m.lat.observe(0.1)
+                m.lat.labels(bucket=str(n)).observe(0.1)
+        """
+        found = run_on(
+            MetricsChecker(),
+            {
+                METRICS_REL: self.EXEMPLAR_METRICS,
+                "bench/helper.py": user_src,
+            },
+        )
+        assert found == []
+
 
 # --- framework mechanics -----------------------------------------------------
 

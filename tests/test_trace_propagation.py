@@ -439,6 +439,43 @@ class TestTraceMerge:
         merged = trace_merge.merge([doc])
         assert merged["otherData"]["skew_corrections_us"] == [0.0]
 
+    def test_unusable_exports_skipped_not_fatal(self, capsys):
+        """Regression (ISSUE 18): a drained ring (zero complete spans)
+        or a pre-epoch export (no epoch_unix_us anchor) must not kill
+        the merge or scatter the fleet timeline — it is skipped with a
+        warning and counted."""
+        good = _doc(1_000_000.0, [
+            {"name": "x", "ph": "X", "ts": 5.0, "span_id": "g1",
+             "trace_id": "t"},
+        ])
+        drained = _doc(1_000_100.0, [
+            {"name": "only_an_instant", "ph": "i", "ts": 1.0},
+        ])
+        no_epoch = {
+            "traceEvents": [
+                {"name": "y", "ph": "X", "ts": 9.0, "span_id": "n1",
+                 "trace_id": "t"},
+            ],
+            "otherData": {},
+        }
+        merged = trace_merge.merge([good, drained, no_epoch])
+        assert merged["otherData"]["merged_from"] == 1
+        assert merged["otherData"]["skipped"] == 2
+        assert [e["span_id"] for e in merged["traceEvents"]] == ["g1"]
+        err = capsys.readouterr().err
+        assert "no complete spans" in err
+        assert "epoch_unix_us" in err
+        # explicit 0.0 anchor is NOT missing (single-doc exports)
+        assert trace_merge.merge([_doc(0.0, good["traceEvents"])])[
+            "otherData"
+        ]["skipped"] == 0
+
+    def test_all_unusable_yields_empty_merge(self):
+        merged = trace_merge.merge([{"traceEvents": [], "otherData": {}}])
+        assert merged["traceEvents"] == []
+        assert merged["otherData"]["merged_from"] == 0
+        assert merged["otherData"]["skipped"] == 1
+
     def test_link_instant_adds_parent_edge(self):
         doc = _doc(0.0, [
             {"name": "waiter_b", "ph": "X", "ts": 0.0, "span_id": "w2",
