@@ -1,0 +1,131 @@
+"""Spawned verifyd shard fleets for the ``verifyd_fleet`` bench section.
+
+Shards must be real OS processes, not threads: the section's claims —
+aggregate sigs/s scaling with shard count, per-shard resident tables
+staying flat — are exactly the properties the GIL and the
+process-singleton resident store would fake in-process. Each child runs
+one ``VerifydServer`` with a MODELED verifier (a fixed sleep per lane;
+the bytes are never read) and the server's REAL hot-key pin path, so
+the pinned slice each shard reports over STATS_PATH is genuine
+``ops.resident`` accounting, not bench bookkeeping.
+
+``shard_main`` is module-level so the spawn context can pickle it. The
+child reports its bound address through a Pipe and blocks until the
+parent sends stop (or the Pipe hits EOF with the parent — no orphans).
+A mid-run ``ShardFleet.kill`` is SIGKILL, the same abrupt death the
+chaos suite models: no graceful drain, in-flight connections reset.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import time
+from typing import Dict, List, Optional
+
+
+def shard_main(shard_id: int, conn, lane_us: int) -> None:
+    """Child entry: one verifyd shard process serving until told stop."""
+    from tendermint_tpu.ops import introspect
+    from tendermint_tpu.verifyd.server import VerifydServer
+
+    def modeled(pks, msgs, sigs):
+        time.sleep(lane_us * 1e-6 * len(pks))
+        return [True] * len(pks)
+
+    introspect.set_shard_identity(shard_id)
+    srv = VerifydServer(
+        verify_fn=modeled,
+        max_batch=512,
+        max_delay=0.001,
+        admission_cap=8192,
+        max_pending=8192,
+        shard_id=shard_id,
+        shm="off",
+    )
+    srv.start()
+    host, port = srv.address
+    try:
+        conn.send("%s:%d" % (host, port))
+        try:
+            conn.recv()  # any message (or parent death) = stop
+        except EOFError:
+            pass
+    finally:
+        srv.stop()
+
+
+class ShardFleet:
+    """Launch/kill/stop a set of shard child processes (bench harness).
+
+    ``addrs[i]`` is shard i's listen address in launch order — the same
+    order the parent's FederationClient numbers its shards, so a
+    ``kill(sid)`` here is exactly the federation's shard ``sid``.
+    """
+
+    def __init__(self, lane_us: int):
+        self.lane_us = lane_us
+        self._ctx = multiprocessing.get_context("spawn")
+        self._procs: List[Optional[object]] = []
+        self._conns: List[Optional[object]] = []
+        self.addrs: List[str] = []
+
+    def launch(self, n_shards: int, startup_timeout: float = 60.0) -> List[str]:
+        for sid in range(n_shards):
+            parent_conn, child_conn = self._ctx.Pipe()
+            proc = self._ctx.Process(
+                target=shard_main,
+                args=(sid, child_conn, self.lane_us),
+                daemon=True,
+            )
+            proc.start()
+            child_conn.close()
+            self._procs.append(proc)
+            self._conns.append(parent_conn)
+        deadline = time.monotonic() + startup_timeout
+        for sid, conn in enumerate(self._conns):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not conn.poll(remaining):
+                self.stop_all()
+                raise RuntimeError("shard %d failed to start" % sid)
+            self.addrs.append(conn.recv())
+        return list(self.addrs)
+
+    def kill(self, sid: int) -> None:
+        """SIGKILL a shard: abrupt death, in-flight connections reset."""
+        proc = self._procs[sid]
+        if proc is not None:
+            proc.kill()
+            proc.join(timeout=10)
+            self._procs[sid] = None
+        conn = self._conns[sid]
+        if conn is not None:
+            conn.close()
+            self._conns[sid] = None
+
+    def alive(self) -> Dict[int, bool]:
+        return {
+            sid: (p is not None and p.is_alive())
+            for sid, p in enumerate(self._procs)
+        }
+
+    def stop_all(self) -> None:
+        for conn in self._conns:
+            if conn is None:
+                continue
+            try:
+                conn.send("stop")
+            except (OSError, BrokenPipeError):
+                pass  # child already gone; the join below reaps it
+        for proc in self._procs:
+            if proc is None:
+                continue
+            proc.join(timeout=10)
+            if proc.is_alive():
+                proc.kill()
+                proc.join(timeout=10)
+        for conn in self._conns:
+            if conn is not None:
+                conn.close()
+        self._procs = []
+        self._conns = []
+        self.addrs = []

@@ -691,6 +691,283 @@ def run_verifyd_shm(beat) -> dict:
     return {"verifyd_shm": out}
 
 
+def run_verifyd_fleet(beat) -> dict:
+    """Verifyd federation scaling (ISSUE 19): 1/2/4 spawned shard
+    processes under the same two-tenant mixed-committee load, one
+    FederationClient per tenant routing by validator-set digest. The
+    section PROVES three claims over the wire, not by bookkeeping:
+    tables are partitioned (per-shard pinned slices from STATS_PATH are
+    pairwise disjoint and each shard stages a fraction of the
+    single-shard bytes), aggregate sigs/s scales with shard count
+    (2 shards >= 1.5x one shard), and a mid-load SIGKILL of a shard
+    finishes the round with ZERO silent drops (every lane verdicted;
+    every False lane explained by the host-oracle counter). Shards are
+    real processes (bench/fleet.py) because the GIL and the
+    process-singleton resident store would fake both scaling and
+    disjointness in-process; the verifier is MODELED (fixed sleep per
+    lane, declared ``verify: modeled``) so the scaling measured is the
+    federation's, not the kernel's."""
+    import hashlib
+    import threading
+
+    from bench.fleet import ShardFleet
+    from tendermint_tpu.ops.resident import TABLE_BYTES_PER_KEY
+    from tendermint_tpu.verifyd import protocol
+    from tendermint_tpu.verifyd.federation import FederationClient
+
+    rounds = env_int("BENCH_FLEET_ROUNDS", 6)
+    kill_rounds = env_int("BENCH_FLEET_KILL_ROUNDS", 3)
+    n_committees = env_int("BENCH_FLEET_COMMITTEES", 8)
+    lanes_per = env_int("BENCH_FLEET_LANES", 16)
+    lane_us = env_int("BENCH_FLEET_LANE_US", 200)
+    max_shards = env_int("BENCH_FLEET_MAX_SHARDS", 4)
+    shard_counts = [n for n in (1, 2, 4) if n <= max_shards] or [1]
+
+    # deterministic synthetic committees (4 keys each): the modeled
+    # verifier never reads the bytes, and FIXED keys make the ring
+    # split — hence the disjointness assertion — reproducible, not
+    # a coin flip per run
+    committees = [
+        [
+            hashlib.sha256(b"fleet-committee-%d-key-%d" % (c, k)).digest()
+            for k in range(4)
+        ]
+        for c in range(n_committees)
+    ]
+    batch_pks, batch_msgs, batch_sigs = [], [], []
+    for c, keys in enumerate(committees):
+        for i in range(lanes_per):
+            batch_pks.append(keys[i % len(keys)])
+            batch_msgs.append(b"fleet-c%02d-lane-%04d" % (c, i))
+            batch_sigs.append(b"\x06" * 64)
+    lanes_per_call = len(batch_pks)
+
+    tenant_specs = (("consensus", 500), ("rpc", 0))
+
+    def drive(fed, klass, n_rounds, errs, false_lanes):
+        """One tenant's load: n_rounds mixed batches spanning every
+        committee. Records verdict-count mismatches (silent drops) and
+        False verdicts (host-oracle lanes — modeled sigs are garbage)."""
+        for _ in range(n_rounds):
+            try:
+                oks = fed.verify(
+                    batch_pks, batch_msgs, batch_sigs, klass=klass
+                )
+            except Exception as exc:  # the ladder must never raise
+                errs.append(repr(exc))
+                continue
+            if len(oks) != lanes_per_call:
+                errs.append(
+                    "verdict count %d != %d" % (len(oks), lanes_per_call)
+                )
+            false_lanes[0] += sum(1 for ok in oks if not ok)
+
+    out = {
+        "verify": "modeled",
+        "lane_us": lane_us,
+        "committees": n_committees,
+        "lanes_per_call": lanes_per_call,
+        "tenants": [t for t, _ in tenant_specs],
+        "rounds": rounds,
+        "shards": {},
+    }
+    single_bytes = None
+    for n_shards in shard_counts:
+        beat("launching %d shard(s)" % n_shards)
+        fleet = ShardFleet(lane_us=lane_us)
+        feds = []
+        try:
+            addrs = fleet.launch(n_shards)
+            feds = [
+                FederationClient(addrs, tenant=t, slo_ms=slo, timeout=30.0)
+                for t, slo in tenant_specs
+            ]
+            for fed in feds:
+                for keys in committees:
+                    fed.note_validator_set(keys)
+            # warm round: establishes connections and trips the
+            # server-side hot-key pin threshold on every committee
+            for fed, (t, _) in zip(feds, tenant_specs):
+                klass = (
+                    protocol.CLASS_CONSENSUS
+                    if t == "consensus"
+                    else protocol.CLASS_RPC
+                )
+                oks = fed.verify(batch_pks, batch_msgs, batch_sigs, klass=klass)
+                if not all(oks):
+                    raise AssertionError("modeled verify must pass warm round")
+            beat("measuring %d shard(s) rounds=%d" % (n_shards, rounds))
+            errs: list = []
+            false_counts = [[0] for _ in feds]
+            threads = [
+                threading.Thread(
+                    target=drive,
+                    args=(
+                        fed,
+                        protocol.CLASS_CONSENSUS
+                        if t == "consensus"
+                        else protocol.CLASS_RPC,
+                        rounds,
+                        errs,
+                        fc,
+                    ),
+                )
+                for fed, (t, _), fc in zip(feds, tenant_specs, false_counts)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errs:
+                raise AssertionError("healthy rounds errored: %s" % errs[:3])
+            if any(fc[0] for fc in false_counts):
+                raise AssertionError(
+                    "healthy rounds hit host fallback (shards overloaded?)"
+                )
+            sigs_per_s = len(feds) * rounds * lanes_per_call / wall
+            # partitioning proof, over the wire: each shard's pinned
+            # slice from STATS_PATH, pairwise disjoint, full coverage
+            gossip = feds[0].refresh(timeout=5.0)
+            pinned = {
+                sid: set(snap.get("pinned_keys") or [])
+                for sid, snap in gossip.items()
+            }
+            staged = {
+                sid: int((snap.get("resident") or {}).get(
+                    "host_staged_bytes", 0
+                ))
+                for sid, snap in gossip.items()
+            }
+            all_keys: set = set()
+            for sid, keys in pinned.items():
+                overlap = all_keys & keys
+                if overlap:
+                    raise AssertionError(
+                        "shards replicate %d key(s) — partition violated"
+                        % len(overlap)
+                    )
+                all_keys |= keys
+            want_keys = {pk.hex() for pk in batch_pks}
+            if all_keys != want_keys:
+                raise AssertionError(
+                    "pinned union %d keys != workload %d"
+                    % (len(all_keys), len(want_keys))
+                )
+            entry = {
+                "sigs_per_s": round(sigs_per_s, 1),
+                "wall_s": round(wall, 3),
+                "pinned_keys": {
+                    "shard%d" % s: len(k) for s, k in pinned.items()
+                },
+                "host_staged_bytes": {
+                    "shard%d" % s: b for s, b in staged.items()
+                },
+                "disjoint": True,
+            }
+            if n_shards == 1:
+                single_bytes = sum(staged.values())
+                if single_bytes != len(want_keys) * TABLE_BYTES_PER_KEY:
+                    raise AssertionError(
+                        "single-shard staged bytes %d != %d keys x %d"
+                        % (single_bytes, len(want_keys), TABLE_BYTES_PER_KEY)
+                    )
+            elif single_bytes:
+                worst = max(staged.values())
+                entry["max_shard_bytes_vs_single"] = round(
+                    worst / single_bytes, 3
+                )
+                if worst >= single_bytes:
+                    raise AssertionError(
+                        "a shard staged the full table set (%d >= %d): "
+                        "replicated, not partitioned" % (worst, single_bytes)
+                    )
+            out["shards"][str(n_shards)] = entry
+
+            if n_shards == 2 and kill_rounds > 0:
+                # failover: SIGKILL a shard that owns committees while
+                # both tenants are mid-load; the round must finish with
+                # every lane verdicted and every False lane explained
+                victim = feds[0].shard_for(committees[0][0])
+                base_fallback = [
+                    fed.stats()["host_fallback_lanes"] for fed in feds
+                ]
+                beat("killing shard %d mid-load" % victim)
+                errs2: list = []
+                false2 = [[0] for _ in feds]
+                threads = [
+                    threading.Thread(
+                        target=drive,
+                        args=(
+                            fed,
+                            protocol.CLASS_CONSENSUS
+                            if t == "consensus"
+                            else protocol.CLASS_RPC,
+                            kill_rounds,
+                            errs2,
+                            fc,
+                        ),
+                    )
+                    for fed, (t, _), fc in zip(feds, tenant_specs, false2)
+                ]
+                for t in threads:
+                    t.start()
+                # land the kill inside the first round, not between them
+                time.sleep(lanes_per_call * lane_us * 1e-6 * 0.5)
+                fleet.kill(victim)
+                for t in threads:
+                    t.join()
+                if errs2:
+                    raise AssertionError(
+                        "failover rounds errored: %s" % errs2[:3]
+                    )
+                explained = sum(
+                    fed.stats()["host_fallback_lanes"] - b
+                    for fed, b in zip(feds, base_fallback)
+                )
+                unexplained = sum(fc[0] for fc in false2) - explained
+                if unexplained:
+                    raise AssertionError(
+                        "%d False lane(s) not explained by the host-"
+                        "oracle counter: silent corruption" % unexplained
+                    )
+                moved = sum(
+                    fed.stats()["failovers"] + fed.stats()["host_fallback_lanes"]
+                    for fed in feds
+                )
+                if moved <= 0:
+                    raise AssertionError(
+                        "shard kill produced no failovers — ladder inert"
+                    )
+                out["failover"] = {
+                    "killed_shard": victim,
+                    "rounds_after_kill": kill_rounds,
+                    "failovers": sum(f.stats()["failovers"] for f in feds),
+                    "rerouted_lanes": sum(
+                        f.stats()["rerouted_lanes"] for f in feds
+                    ),
+                    "host_fallback_lanes": explained,
+                    "unexplained_false_lanes": 0,
+                    "zero_silent_drops": True,
+                }
+        finally:
+            for fed in feds:
+                fed.close()
+            fleet.stop_all()
+
+    one = out["shards"].get("1", {}).get("sigs_per_s")
+    two = out["shards"].get("2", {}).get("sigs_per_s")
+    if one and two:
+        out["scaling_2x_over_1x"] = round(two / one, 2)
+        if two < 1.5 * one:
+            raise AssertionError(
+                "2-shard aggregate %.1f sigs/s < 1.5x single-shard %.1f"
+                % (two, one)
+            )
+    return {"verifyd_fleet": out}
+
+
 def run_latency_attrib(beat) -> dict:
     """End-to-end latency attribution (ISSUE 15): the stage-time vector
     every verifyd response carries must actually EXPLAIN the latency the
@@ -1407,6 +1684,19 @@ _ALL = (
             ("BENCH_SHM_ROUNDS", 12, 4),
         ),
         skip_env=("BENCH_SKIP_VERIFYD_SHM",),
+    ),
+    Section(
+        "verifyd_fleet",
+        run_verifyd_fleet,
+        # the disjointness proof rides the server's REAL hot-key pin
+        # path (ops/resident), so the shard children need the ops
+        # engine importable even though the verifier is modeled
+        degrade=(
+            ("BENCH_FLEET_MAX_SHARDS", 4, 2),
+            ("BENCH_FLEET_ROUNDS", 6, 2),
+            ("BENCH_FLEET_LANES", 16, 8),
+        ),
+        skip_env=("BENCH_SKIP_VERIFYD_FLEET",),
     ),
     Section(
         "latency_attrib",

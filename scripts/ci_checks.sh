@@ -534,6 +534,82 @@ python -m scripts.bench_diff --tolerance 75 --strict-missing \
     || { echo "introspect: smoke diff vs checked-in fingerprint failed" >&2; \
          rc_total=1; }
 
+echo "== verifyd federation: sanitized suites + seeded failover explore =="
+# ISSUE 19 stage: the digest-routed shard federation. The routing and
+# failover suites (plus the shard-kill chaos test) run under
+# happens-before race detection — the FederationClient's membership
+# state (_dead/_owner/route_epoch) is @instrument_attrs-instrumented,
+# so a racy ladder walk surfaces as a DATA RACE marker, not a flake.
+rm -f /tmp/_tpusan_fed.log
+timeout -k 10 850 env TENDERMINT_TPU_SANITIZE=hb JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_federation.py tests/test_verifyd_chaos.py \
+    -q -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+    | tee /tmp/_tpusan_fed.log
+[ "${PIPESTATUS[0]}" -ne 0 ] && rc_total=1
+if grep -q "DATA RACE" /tmp/_tpusan_fed.log; then
+    echo "federation: data race detected (stacks above)" >&2
+    rc_total=1
+fi
+if grep -q "LOCK-ORDER CYCLE" /tmp/_tpusan_fed.log; then
+    echo "federation: lock-order cycle detected" >&2
+    rc_total=1
+fi
+# the failover ladder under 10 seeded interleavings: mark-dead vs
+# revive vs concurrent group dispatch is the exact hand-off a bad
+# schedule would tear (same seed -> same schedule, exact replay)
+for seed in 0 1 2 3 4 5 6 7 8 9; do
+    timeout -k 10 180 env TENDERMINT_TPU_SANITIZE=explore:$seed \
+        JAX_PLATFORMS=cpu python -m pytest \
+        "tests/test_federation.py::TestFailover" -q \
+        -p no:cacheprovider -p no:xdist -p no:randomly \
+        > /tmp/_tpusan_fed_explore.log 2>&1 || {
+        echo "federation explore: FAILED under seed $seed — replay with" \
+             "TENDERMINT_TPU_SANITIZE=explore:$seed" >&2
+        tail -20 /tmp/_tpusan_fed_explore.log >&2
+        rc_total=1
+    }
+done
+
+echo "== bench smoke (verifyd_fleet, 2 shards) =="
+# The federation acceptance, over the wire: 2 spawned shard processes
+# must pin strictly disjoint resident-table slices (the section fails
+# itself on any overlap or coverage gap), aggregate modeled sigs/s
+# must scale >= 1.5x over one shard, and the mid-load SIGKILL round
+# must finish with zero silent drops.
+rm -rf /tmp/_bench_fleet && mkdir -p /tmp/_bench_fleet
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    BENCH_SECTIONS=verifyd_fleet BENCH_FLEET_MAX_SHARDS=2 \
+    BENCH_FLEET_ROUNDS=4 \
+    BENCH_SECTION_TIMEOUT=240 BENCH_SECTION_ATTEMPTS=1 \
+    BENCH_PARTIAL=/tmp/_bench_fleet/partial.json \
+    python bench.py > /tmp/_bench_fleet/out.json || {
+    echo "bench verifyd_fleet smoke: non-zero rc" >&2
+    rc_total=1
+}
+python - <<'EOF' || rc_total=1
+import json
+doc = json.load(open("/tmp/_bench_fleet/out.json"))
+sec = doc["sections"]["verifyd_fleet"]
+assert sec["status"] == "ok", "verifyd_fleet section: %s" % sec
+fleet = doc["verifyd_fleet"]
+assert fleet["verify"] == "modeled", fleet  # honesty declared
+two = fleet["shards"]["2"]
+assert two["disjoint"] is True, two
+pinned = two["pinned_keys"]
+assert len(pinned) == 2 and all(n > 0 for n in pinned.values()), pinned
+assert sum(pinned.values()) == fleet["committees"] * 4, pinned
+assert two["max_shard_bytes_vs_single"] < 1.0, two
+assert fleet["scaling_2x_over_1x"] >= 1.5, fleet["scaling_2x_over_1x"]
+fo = fleet["failover"]
+assert fo["zero_silent_drops"] is True, fo
+assert fo["unexplained_false_lanes"] == 0, fo
+print(
+    "verifyd_fleet smoke ok: %.2fx scaling, pinned split %s, "
+    "%d lanes rerouted on shard kill"
+    % (fleet["scaling_2x_over_1x"], pinned, fo["rerouted_lanes"])
+)
+EOF
+
 echo "== tier-1 pytest =="
 set -o pipefail
 rm -f /tmp/_t1.log

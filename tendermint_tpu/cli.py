@@ -516,11 +516,74 @@ def cmd_light(args) -> int:
     return 0
 
 
+def _verifyd_stats(args) -> int:
+    """``verifyd stats``: poll every shard's STATS_PATH gossip snapshot
+    and print the fleet roll-up — per-shard rows plus the owner-wise
+    aggregate, reusing the introspect owner labels so partitioned vs
+    replicated table bytes are visible at a glance."""
+    from tendermint_tpu.verifyd.federation import FederationClient
+
+    shards = [a.strip() for a in (args.shards or "").split(",") if a.strip()]
+    if not shards:
+        shards = [args.listen]
+    fed = FederationClient(shards)
+    try:
+        rows = fed.memstats_rows(timeout=2.0)
+        if not rows:
+            print("verifyd stats: no shard reachable", flush=True)
+            return 1
+        print(
+            f"{'shard':<8} {'addr':<22} {'served':>8} {'misroute':>9} "
+            f"{'pinned':>7} {'host_B':>10} {'device_B':>10}"
+        )
+        agg_owner: dict = {}
+        agg = {"served": 0, "misroutes": 0, "pinned": 0, "host": 0}
+        for label in sorted(rows):
+            row = rows[label]
+            dev = row.get("device_bytes") or {}
+            dev_total = sum(int(v) for v in dev.values())
+            for owner, n in dev.items():
+                agg_owner[owner] = agg_owner.get(owner, 0) + int(n)
+            served = int(row.get("requests_served", 0))
+            mis = int(row.get("misroutes", 0))
+            pinned = int(row.get("pinned_keys", 0))
+            host_b = int(row.get("host_staged_bytes", 0))
+            agg["served"] += served
+            agg["misroutes"] += mis
+            agg["pinned"] += pinned
+            agg["host"] += host_b
+            print(
+                f"{label:<8} {row.get('addr', ''):<22} {served:>8} "
+                f"{mis:>9} {pinned:>7} {host_b:>10} {dev_total:>10}"
+            )
+        print(
+            f"{'fleet':<8} {'(aggregate)':<22} {agg['served']:>8} "
+            f"{agg['misroutes']:>9} {agg['pinned']:>7} {agg['host']:>10} "
+            f"{sum(agg_owner.values()):>10}"
+        )
+        for owner in sorted(agg_owner):
+            print(f"  {owner}: {agg_owner[owner]} bytes (fleet)")
+        tenants = fed.fleet_tenants()
+        for label in sorted(tenants):
+            ts = tenants[label]
+            print(
+                f"  tenant {label}: p99={ts['p99_ms']}ms "
+                f"slo={ts['slo_ms'] or 'none'} "
+                f"slo_sheds={ts['slo_sheds']} lanes={ts['lanes']}"
+            )
+        return 0
+    finally:
+        fed.close()
+
+
 def cmd_verifyd(args) -> int:
     """Run the standalone verification service (verifyd/server.py): one
     resident accelerator serving batched signature verification to many
     nodes/light clients. ``--metrics HOST:PORT`` additionally serves the
-    Prometheus registry (and /debug/traces) over HTTP."""
+    Prometheus registry (and /debug/traces) over HTTP. With
+    ``--shard-id/--shards`` the daemon serves as one federation shard
+    (verifyd/federation.py) and its /debug/memstats grows the fleet
+    roll-up; the ``stats`` action prints that roll-up and exits."""
     from tendermint_tpu.libs.metrics import (
         EvloopMetrics,
         Registry,
@@ -529,6 +592,8 @@ def cmd_verifyd(args) -> int:
     from tendermint_tpu.parallel import mesh
     from tendermint_tpu.verifyd.server import VerifydServer
 
+    if args.action == "stats":
+        return _verifyd_stats(args)
     mesh.manager.configure(args.mesh)
     if args.trace:
         from tendermint_tpu.libs import tracing
@@ -564,6 +629,7 @@ def cmd_verifyd(args) -> int:
             None if args.dyn_batch == "auto" else args.dyn_batch == "on"
         ),
         tenant_slos=tenant_slos,
+        shard_id=args.shard_id,
     )
     metrics_server = None
     if args.metrics:
@@ -588,6 +654,28 @@ def cmd_verifyd(args) -> int:
     from tendermint_tpu.ops import introspect
 
     introspect.install()
+    introspect.set_shard_identity(args.shard_id)
+    # federated daemon: GET /debug/memstats (and the flight recorder)
+    # grow a fleet section — per-shard device-byte rows polled from the
+    # shard list's STATS_PATH endpoints, cached so memstats polling
+    # doesn't turn into a gossip storm
+    fleet_fed = None
+    if args.shards:
+        from tendermint_tpu.verifyd.federation import FederationClient
+
+        fleet_fed = FederationClient(
+            [a.strip() for a in args.shards.split(",") if a.strip()]
+        )
+        fleet_cache = {"t": -10.0, "rows": {}}
+
+        def _fleet_rows():
+            now = time.monotonic()
+            if now - fleet_cache["t"] >= 2.0:
+                fleet_cache["t"] = now
+                fleet_cache["rows"] = fleet_fed.memstats_rows(timeout=1.0)
+            return fleet_cache["rows"]
+
+        introspect.set_fleet_provider(_fleet_rows)
     server.start()
     if metrics_server is not None:
         metrics_server.start()
@@ -606,7 +694,9 @@ def cmd_verifyd(args) -> int:
         f"dyn_batch={'on' if server.dyn_batch else 'off'}, "
         f"tenant_slos={sorted(tenant_slos) if tenant_slos else 'none'}, "
         f"tenant_cap={args.tenant_cap}, "
-        f"shm={shm_banner})",
+        f"shm={shm_banner}, "
+        f"shard={args.shard_id if args.shard_id >= 0 else 'standalone'}"
+        f"{'/' + str(len(args.shards.split(','))) if args.shards else ''})",
         flush=True,
     )
     try:
@@ -616,6 +706,9 @@ def cmd_verifyd(args) -> int:
         if metrics_server is not None:
             metrics_server.stop()
         server.stop()
+        if fleet_fed is not None:
+            introspect.set_fleet_provider(None)
+            fleet_fed.close()
     return 0
 
 
@@ -1111,8 +1204,25 @@ def build_parser() -> argparse.ArgumentParser:
         "verifyd", help="run the shared verification daemon"
     )
     p.add_argument(
+        "action", nargs="?", choices=("serve", "stats"), default="serve",
+        help="serve (default) runs the daemon; stats prints a fleet "
+        "roll-up (per-shard rows + aggregate) from --shards/--listen",
+    )
+    p.add_argument(
         "--listen", default="127.0.0.1:26670", metavar="HOST:PORT",
         help="gRPC listen address",
+    )
+    p.add_argument(
+        "--shard-id", type=int, default=-1,
+        help="this daemon's federation shard ordinal (stamped on every "
+        "response, wire field 6; -1 = standalone)",
+    )
+    p.add_argument(
+        "--shards", default="", metavar="HOST:PORT,HOST:PORT,...",
+        help="the full federation shard list (verifyd/federation.py): "
+        "clients consistent-hash validator-set digests across it; a "
+        "serving daemon also uses it for the /debug/memstats fleet "
+        "roll-up, and `verifyd stats` polls it",
     )
     p.add_argument(
         "--max-batch", type=int, default=None,

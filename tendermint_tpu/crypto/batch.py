@@ -26,14 +26,21 @@ DEVICE_THRESHOLD = 16
 
 
 def remote_verify_backend():
-    """The verifyd remote backend's ``verify_fn`` when one is configured
-    (``TENDERMINT_TPU_VERIFY_REMOTE`` / ``[ops] verify_remote``), else
-    None. Lazy import keeps crypto importable without the service."""
+    """The verifyd remote backend's ``verify_fn`` when one is configured,
+    else None. A shard federation (``TENDERMINT_TPU_VERIFY_SHARDS`` /
+    ``verifyd.federation.set_federation``) outranks the single-remote
+    config (``TENDERMINT_TPU_VERIFY_REMOTE`` / ``[ops] verify_remote``):
+    when both are set the federation's digest router owns placement.
+    Lazy import keeps crypto importable without the service."""
     try:
         from tendermint_tpu.verifyd import client as vclient
+        from tendermint_tpu.verifyd import federation as vfederation
     except ImportError:
         return None
     try:
+        fed = vfederation.federation_backend()
+        if fed is not None:
+            return fed
         return vclient.remote_backend()
     except Exception:
         return None
@@ -63,17 +70,32 @@ def note_validator_set(vals) -> None:
     """Register the active validator set with the device precompute
     cache (ops/precompute.py): its ed25519 keys become eligible for
     per-validator table caching, and stale keys from rotated-out sets
-    are dropped. Never raises — cache warm-up must not be able to fail
-    a verification — and stays a no-op when the ops engine is absent.
+    are dropped. When a verifyd federation is configured, the set's
+    digest also becomes the routing key of every member key, so the
+    whole committee's traffic pins tables on ONE shard (partitioned,
+    not replicated). Never raises — cache warm-up must not be able to
+    fail a verification — and stays a no-op when the ops engine is
+    absent.
     """
     try:
         from tendermint_tpu.ops import precompute
     except ImportError:
-        return
+        precompute = None
+    if precompute is not None:
+        try:
+            precompute.activate_validator_set(vals)
+        except Exception:
+            pass  # cache warm-up must never fail a verification
+    # federation routing hook: same best-effort contract
     try:
-        precompute.activate_validator_set(vals)
+        from tendermint_tpu.ops.precompute import _vset_ed25519_keys
+        from tendermint_tpu.verifyd import federation as vfederation
+
+        keys = _vset_ed25519_keys(vals)
+        if keys:
+            vfederation.note_validator_set(sorted(keys))
     except Exception:
-        pass
+        pass  # routing locality is an optimization, never a failure
 
 
 class BatchVerifier:

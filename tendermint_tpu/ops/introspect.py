@@ -439,13 +439,91 @@ def _exec_cache_entries() -> Dict[str, int]:
     return out
 
 
+# --- federation fleet roll-up -------------------------------------------------
+# A federated process (verifyd serve --shards, or a node routing to a
+# fleet) installs a provider returning per-shard ledger rows; memstats
+# then carries a "fleet" section — per-shard device bytes under the
+# SAME owner labels as the local ledger, plus the owner-wise aggregate,
+# so partitioned vs replicated table placement is visible at a glance
+# (each shard's resident_tables entry disjoint => sum grows linearly).
+
+_fleet_mtx = threading.Lock()
+_fleet_provider: Optional[Callable[[], Dict[str, Dict[str, Any]]]] = None
+_shard_id = -1
+
+
+def set_shard_identity(shard_id: int) -> None:
+    """Stamp this process's federation shard id into memstats (-1 =
+    standalone, omitted from the snapshot)."""
+    global _shard_id
+    with _fleet_mtx:
+        _shard_id = int(shard_id)
+
+
+def shard_identity() -> int:
+    with _fleet_mtx:
+        return _shard_id
+
+
+def set_fleet_provider(
+    fn: Optional[Callable[[], Dict[str, Dict[str, Any]]]]
+) -> None:
+    """Install (or clear, with None) the fleet roll-up source: a
+    callable returning ``{shard_label: {"device_bytes": {owner: n},
+    ...}}`` rows. Must be cheap or internally rate-limited — memstats
+    is polled by /debug/memstats and the flight recorder."""
+    global _fleet_provider
+    with _fleet_mtx:
+        _fleet_provider = fn
+
+
+def fleet_rollup() -> Optional[Dict[str, Any]]:
+    """The fleet section, or None when unfederated/unavailable: the
+    provider's per-shard rows plus the owner-wise byte aggregate."""
+    with _fleet_mtx:
+        provider = _fleet_provider
+    if provider is None:
+        return None
+    try:
+        rows = provider() or {}
+    except Exception:
+        return None
+    if not isinstance(rows, dict) or not rows:
+        return None
+    agg: Dict[str, int] = {}
+    for row in rows.values():
+        if not isinstance(row, dict):
+            continue
+        owners = row.get("device_bytes")
+        if not isinstance(owners, dict):
+            continue
+        for owner, n in owners.items():
+            try:
+                agg[owner] = agg.get(owner, 0) + int(n)
+            except (TypeError, ValueError):
+                continue
+    return {
+        "shards": rows,
+        "aggregate_bytes": dict(sorted(agg.items())),
+        "aggregate_total": sum(agg.values()),
+    }
+
+
 def memstats() -> Dict[str, Any]:
     """The full device-tier snapshot: the accountant's ledger, the
     resident store's own counters (so byte claims are cross-checkable
     against uploads), and the profiler digests. This is the payload of
     ``GET /debug/memstats``, the ``verifyd stats`` memstats field, and
-    the flight-recorder ``memstats`` section."""
+    the flight-recorder ``memstats`` section. Federated processes grow
+    a ``fleet`` section (per-shard rows + owner-wise aggregate) and a
+    ``shard_id`` stamp."""
     out = accountant.snapshot()
+    sid = shard_identity()
+    if sid >= 0:
+        out["shard_id"] = sid
+    fleet = fleet_rollup()
+    if fleet is not None:
+        out["fleet"] = fleet
     live = _exec_cache_entries()
     if live:
         merged = dict(out.get("exec_cache_entries", {}))

@@ -54,6 +54,13 @@ _ENV = "TENDERMINT_TPU_RESIDENT"
 _HOT_PIN_THRESHOLD = 2
 _HOT_TRACK_CAP = 4096
 
+# Host-staged footprint of one key's signed-window table: the
+# ``(8, 4, 32)`` uint8 column that joins the resident upload. Pinned
+# keys hold this much host memory whether or not a device copy exists,
+# so the partitioned-fleet ledger can show per-shard table placement
+# even on CPU (where the device upload never happens).
+TABLE_BYTES_PER_KEY = 8 * 4 * 32
+
 
 def _platform(backend: Optional[str]) -> str:
     try:
@@ -89,6 +96,12 @@ class ResidentTableStore:
         self._hot_counts: Dict[bytes, int] = {}  # guarded-by: _lock
         self._tenant_pins: Dict[str, int] = {}  # guarded-by: _lock
         self.pin_quota_denials = 0  # guarded-by: _lock
+        # keys THIS process pinned via note_hot_keys — the shard's
+        # slice of the partitioned fleet. Mirrored to the introspect
+        # ledger as host-staged bytes so `verifyd stats` shows table
+        # placement per shard (disjoint across a federation) even on
+        # CPU, where the device upload never happens.
+        self._pinned: set = set()  # guarded-by: _lock
 
     # --- configuration ------------------------------------------------------
 
@@ -209,6 +222,11 @@ class ResidentTableStore:
         """Host cache dropped these keys: the device copy dies with them."""
         keys = [bytes(pk) for pk in pubkeys]
         with self._lock:
+            # an evicted key leaves the shard's pinned slice whether or
+            # not a device copy exists — the host column is gone
+            if any(pk in self._pinned for pk in keys):
+                self._pinned.difference_update(keys)
+                self._account_host_locked()
             if self._tab_dev is None:
                 return
             if not any(pk in self._index for pk in keys):
@@ -219,6 +237,8 @@ class ResidentTableStore:
         with self._lock:
             self._drop_locked()
             self._hot_counts.clear()
+            self._pinned.clear()
+            self._account_host_locked()
 
     def _drop_locked(self) -> None:
         if self._tab_dev is not None:
@@ -234,6 +254,18 @@ class ResidentTableStore:
 
         introspect.set_bytes("resident_tables", 0)
         introspect.accountant.set_tenant_bytes(0, {})
+
+    def _account_host_locked(self) -> None:
+        """Mirror the pinned slice to the introspect ledger under its
+        own owner label ("resident_tables_host"): host-staged bytes,
+        distinct from the device tensor, so a federation's per-shard
+        memstats show the PARTITIONED placement — each shard's entry is
+        its slice, and the fleet aggregate grows linearly."""
+        from tendermint_tpu.ops import introspect
+
+        introspect.set_bytes(
+            "resident_tables_host", len(self._pinned) * TABLE_BYTES_PER_KEY
+        )
 
     # --- lookup -------------------------------------------------------------
 
@@ -344,6 +376,9 @@ class ResidentTableStore:
                     to_pin.append(pk)
                 elif len(self._hot_counts) < _HOT_TRACK_CAP:
                     self._hot_counts[pk] = c
+            if to_pin:
+                self._pinned.update(to_pin)
+                self._account_host_locked()
         if to_pin:
             from tendermint_tpu.ops import precompute
 
@@ -370,7 +405,16 @@ class ResidentTableStore:
                 "gathered_h2d_bytes": self.gathered_h2d_bytes,
                 "invalidations": self.invalidations,
                 "pin_quota_denials": self.pin_quota_denials,
+                "pinned_keys": len(self._pinned),
+                "host_staged_bytes": len(self._pinned) * TABLE_BYTES_PER_KEY,
             }
+
+    def pinned_keys(self) -> list:
+        """Hex identities of this process's pinned slice (sorted). The
+        verifyd_fleet bench compares these across shards to prove the
+        federation PARTITIONS tables instead of replicating them."""
+        with self._lock:
+            return sorted(pk.hex() for pk in self._pinned)
 
     def tenant_pins(self) -> Dict[str, int]:
         """Pins held per tenant namespace (quota introspection)."""
@@ -382,6 +426,8 @@ class ResidentTableStore:
             self._drop_locked()
             self._hot_counts.clear()
             self._tenant_pins.clear()
+            self._pinned.clear()
+            self._account_host_locked()
             self.hits = self.misses = self.uploads = 0
             self.h2d_bytes = self.gathered_h2d_bytes = 0
             self.invalidations = 0
@@ -447,6 +493,10 @@ def note_validator_rotation() -> None:
 
 def stats() -> Dict[str, float]:
     return store.stats()
+
+
+def pinned_keys() -> list:
+    return store.pinned_keys()
 
 
 def reset() -> None:

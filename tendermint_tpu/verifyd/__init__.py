@@ -9,4 +9,7 @@ inference server, applied to Ed25519/sr25519 verification.
 - ``protocol`` — compact varint-framed request/response codec
 - ``server`` — the daemon (priority classes, deadlines, admission)
 - ``client`` — pooled client + remote-backend plumbing for the node
+- ``shm`` — same-host slab-ring transport (negotiated, TCP fallback)
+- ``federation`` — N-shard fleet: client-side consistent-hash routing
+  keyed by validator-set digest, shard failover, fleet stats merge
 """

@@ -36,6 +36,7 @@ package's "light" labeling is not overridden by validation internals.
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import threading
@@ -61,6 +62,7 @@ from tendermint_tpu.verifyd.protocol import (
     KIND_RAW,
     STATUS_NAMES,
     STATUS_OK,
+    STATS_PATH,
     VERIFY_PATH,
     VerifyRequest,
 )
@@ -149,6 +151,7 @@ class VerifydClient:
         shm: Optional[str] = None,
         metrics: Optional[VerifydMetrics] = None,
         slo_ms: int = 0,
+        shard_id: int = -1,
     ):
         host, _, port = addr.rpartition(":")
         if not host or not port.isdigit():
@@ -165,6 +168,13 @@ class VerifydClient:
         # 8, zero = none): the server holds the tenant's attributed
         # latency budget to it (tightest declaration wins server-side)
         self.slo_ms = max(0, int(slo_ms))
+        # federation routing identity: the shard this client believes
+        # it is talking to (-1 = unfederated: fields 9/10 stay off the
+        # wire) and the routing epoch of the shard map that picked it.
+        # The FederationClient bumps route_epoch on membership changes
+        # so the server can count stale-map misroutes honestly.
+        self.shard_id = int(shard_id)
+        self.route_epoch = 0
         # RESOURCE_EXHAUSTED retry budget: sheds are transient (the
         # server's brownout ladder recovers), so wait-and-retry against
         # the remaining deadline before surrendering to the fallback
@@ -359,6 +369,8 @@ class VerifydClient:
                 tenant=req.tenant,
                 trace=req.trace,  # every split rides the same trace
                 slo_ms=req.slo_ms,
+                shard_id=req.shard_id,
+                route_epoch=req.route_epoch,
             )
             resp = self.call(sub, timeout=timeout)
             if resp.status != STATUS_OK:
@@ -414,6 +426,38 @@ class VerifydClient:
         raise VerifydUnavailableError(
             f"verifyd {self.addr} unreachable: {last_exc}"
         )
+
+    def server_stats(self, timeout: float = 2.0) -> dict:
+        """One STATS_PATH round-trip: the server's gossip snapshot
+        (wire counters, tenant SLO view, brownout level, pinned
+        resident-table slice). Raises ``VerifydUnavailableError`` when
+        the server is unreachable or answers garbage — the federation's
+        health refresh treats that as a dead-shard signal."""
+        ch = self._acquire()
+        try:
+            raw = ch.unary(STATS_PATH, b"", timeout=timeout)
+        except GrpcError as exc:
+            self._release(ch)
+            raise VerifydUnavailableError(
+                f"verifyd {self.addr} stats errored: {exc}"
+            ) from exc
+        except (OSError, H2ProtocolError) as exc:
+            self._release(ch, broken=True)
+            raise VerifydUnavailableError(
+                f"verifyd {self.addr} stats unreachable: {exc}"
+            ) from exc
+        self._release(ch)
+        try:
+            snap = json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+            raise VerifydUnavailableError(
+                f"verifyd {self.addr} stats malformed: {exc}"
+            ) from exc
+        if not isinstance(snap, dict):
+            raise VerifydUnavailableError(
+                f"verifyd {self.addr} stats malformed: not an object"
+            )
+        return snap
 
     def verify(
         self,
@@ -472,6 +516,8 @@ class VerifydClient:
                     tenant=self.tenant,
                     trace=trace_bytes,
                     slo_ms=self.slo_ms,
+                    shard_id=self.shard_id,
+                    route_epoch=self.route_epoch,
                 )
                 try:
                     # transport grace past the verify deadline: the

@@ -34,6 +34,17 @@ Request (proto wire form):
                           (zero-omission: a pre-SLO client emits frames
                           byte-identical to before the field existed,
                           and the decoder maps absence back to 0)
+    9  shard     varint   federation shard id the router targeted; the
+                          wire value is shard_id+1 so shard 0 survives
+                          proto3 zero-omission — absent (an unfederated
+                          client) defaults to -1 ("unrouted") and an
+                          unfederated client's frames stay byte-
+                          identical to before the field existed
+    10 epoch     varint   routing epoch of the client's shard map at
+                          send time (bumped on every membership change);
+                          0 = unfederated and is OMITTED (zero-omission:
+                          absence maps back to 0), so the server can
+                          count misroutes without trusting clocks
 
 Response:
     1  status       varint   OK | RESOURCE_EXHAUSTED | DEADLINE_EXCEEDED
@@ -46,6 +57,9 @@ Response:
                              seconds per STAGE_NAMES entry, in order);
                              OMITTED when the server recorded none, so
                              old servers' frames are byte-identical
+    6  shard        varint   the responding server's shard id, +1 on the
+                             wire (same shift as request field 9); absent
+                             (pre-federation server) decodes to -1
 
 ``kind`` is advisory: commit semantics (tallying, sign-bytes
 construction) stay on the client; the server sees only raw lanes, so
@@ -69,6 +83,10 @@ from tendermint_tpu.encoding.proto import (
 )
 
 VERIFY_PATH = "/tendermint.verifyd.Verifier/Verify"
+# unary stats/gossip endpoint: empty request payload, JSON response
+# (server stats + tenant stats + brownout snapshot + shard identity).
+# The federation client polls this to refresh per-shard health.
+STATS_PATH = "/tendermint.verifyd.Verifier/Stats"
 
 # request kinds
 KIND_RAW = 1
@@ -132,6 +150,13 @@ MAX_TRACE_LEN = 64  # wire-level cap; today's context is 17 bytes
 # declare an absurd target that skews the server's budget arithmetic.
 MAX_SLO_MS = 600_000  # 10 minutes — far beyond any real latency SLO
 
+# federation routing (fields 9/10): shard ids are small ordinals into
+# the operator's --shards list; the epoch is a monotone counter bumped
+# on membership change. Both capped so a hostile client can't make the
+# server's misroute bookkeeping allocate per absurd value.
+MAX_SHARD_ID = 4095  # fleet fan-out ceiling, far beyond any real mesh
+MAX_ROUTE_EPOCH = 1 << 31
+
 # End-to-end latency attribution stage vector (response field 5), in
 # wire order. Each stage is one f32 of seconds summed from the server's
 # real spans; together they account for the server-side request wall.
@@ -167,6 +192,8 @@ class VerifyRequest:
     tenant: str = DEFAULT_TENANT
     trace: bytes = b""
     slo_ms: int = 0
+    shard_id: int = -1
+    route_epoch: int = 0
 
     def __len__(self) -> int:
         return len(self.pks)
@@ -179,6 +206,7 @@ class VerifyResponse:
     message: str = ""
     queue_depth: int = 0
     stages: bytes = b""
+    shard_id: int = -1
 
 
 def _encode_lane(pk: bytes, msg: bytes, sig: bytes) -> bytes:
@@ -209,6 +237,13 @@ def encode_request(req: VerifyRequest) -> bytes:
         out += encode_bytes_field(7, req.trace)
     if req.slo_ms:
         out += encode_varint_field(8, req.slo_ms)
+    # shard id rides the wire +1: shard 0 is a legal target, and proto3
+    # zero-omission would otherwise make it indistinguishable from
+    # "unrouted" (-1, the pre-federation default) — same shift as klass
+    if req.shard_id >= 0:
+        out += encode_varint_field(9, req.shard_id + 1)
+    if req.route_epoch:
+        out += encode_varint_field(10, req.route_epoch)
     return bytes(out)
 
 
@@ -247,6 +282,10 @@ def encoded_request_size(req: VerifyRequest) -> int:
         size += 1 + _varint_size(len(req.trace)) + len(req.trace)
     if req.slo_ms:
         size += 1 + _varint_size(req.slo_ms)
+    if req.shard_id >= 0:
+        size += 1 + _varint_size(req.shard_id + 1)
+    if req.route_epoch:
+        size += 1 + _varint_size(req.route_epoch)
     return size
 
 
@@ -288,6 +327,13 @@ def decode_request(data: bytes) -> VerifyRequest:
                 req.trace = r.read_bytes()
             elif fld == 8 and wire == WIRE_VARINT:
                 req.slo_ms = r.read_varint()
+            elif fld == 9 and wire == WIRE_VARINT:
+                # -1 undoes the wire shift; 0 on the wire never occurs
+                # (the encoder omits unrouted requests entirely), so
+                # absence and the dataclass default agree on -1
+                req.shard_id = r.read_varint() - 1
+            elif fld == 10 and wire == WIRE_VARINT:
+                req.route_epoch = r.read_varint()
             else:
                 r.skip(wire)
     except ValueError:
@@ -302,8 +348,14 @@ def decode_request(data: bytes) -> VerifyRequest:
     req.trace = req.trace or b""
     # absence (pre-SLO client) means no declared target (TPW004)
     req.slo_ms = req.slo_ms or 0
+    # absence (unfederated client) means no routing epoch (TPW004)
+    req.route_epoch = req.route_epoch or 0
     if req.slo_ms > MAX_SLO_MS:
         raise ValueError(f"slo_ms too large: {req.slo_ms}")
+    if req.shard_id > MAX_SHARD_ID:
+        raise ValueError(f"shard id too large: {req.shard_id}")
+    if req.route_epoch > MAX_ROUTE_EPOCH:
+        raise ValueError(f"route epoch too large: {req.route_epoch}")
     if len(req.tenant) > MAX_TENANT_LEN:
         raise ValueError(f"tenant name too long: {len(req.tenant)}")
     if len(req.trace) > MAX_TRACE_LEN:
@@ -340,6 +392,11 @@ def encode_response(resp: VerifyResponse) -> bytes:
         out += encode_varint_field(4, resp.queue_depth)
     if resp.stages:
         out += encode_bytes_field(5, resp.stages)
+    # same +1 shift as request field 9: shard 0 must survive
+    # zero-omission, and an unfederated server omits the field so its
+    # frames stay byte-identical to before it existed
+    if resp.shard_id >= 0:
+        out += encode_varint_field(6, resp.shard_id + 1)
     return bytes(out)
 
 
@@ -358,6 +415,8 @@ def decode_response(data: bytes) -> VerifyResponse:
                 resp.queue_depth = r.read_varint()
             elif fld == 5 and wire == WIRE_BYTES:
                 resp.stages = r.read_bytes()
+            elif fld == 6 and wire == WIRE_VARINT:
+                resp.shard_id = r.read_varint() - 1
             else:
                 r.skip(wire)
     except Exception as exc:
@@ -366,4 +425,6 @@ def decode_response(data: bytes) -> VerifyResponse:
     resp.stages = resp.stages or b""
     if resp.status not in STATUS_NAMES:
         raise ValueError(f"unknown status {resp.status}")
+    if resp.shard_id > MAX_SHARD_ID:
+        raise ValueError(f"shard id too large: {resp.shard_id}")
     return resp

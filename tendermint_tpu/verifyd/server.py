@@ -65,6 +65,7 @@ oracle, which is slower but sound (same ZIP-215 ground truth).
 from __future__ import annotations
 
 import hashlib
+import json
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -99,6 +100,7 @@ from tendermint_tpu.verifyd.protocol import (
     STATUS_NAMES,
     STATUS_OK,
     STATUS_RESOURCE_EXHAUSTED,
+    STATS_PATH,
     VERIFY_PATH,
 )
 
@@ -443,8 +445,14 @@ class VerifydServer:
         tenant_slos: Optional[Dict[str, int]] = None,
         slo_breach_after: float = SLO_BREACH_AFTER,
         slo_recover_after: float = SLO_RECOVER_AFTER,
+        shard_id: int = -1,
     ):
         self.metrics = metrics or VerifydMetrics.nop()
+        # federation identity: -1 = standalone (pre-federation wire
+        # behaviour: response field 6 is omitted entirely)
+        self.shard_id = int(shard_id)
+        if self.shard_id > protocol.MAX_SHARD_ID:
+            raise ValueError(f"shard id too large: {self.shard_id}")
         self.max_delay = max_delay
         self.admission = AdmissionController(admission_cap, service_budget)
         self.brownout = brownout or BrownoutController()
@@ -500,6 +508,11 @@ class VerifydServer:
         self.shm_lanes = 0  # guarded-by: _stats_mtx
         self.shm_torn_slabs = 0  # guarded-by: _stats_mtx
         self.shm_fallbacks = 0  # guarded-by: _stats_mtx
+        # requests stamped for a DIFFERENT shard (stale client shard
+        # map); served anyway — routing is placement advice, not an
+        # authorization boundary — but counted so operators see churn
+        self.misroutes = 0  # guarded-by: _stats_mtx
+        self.route_epoch_seen = 0  # guarded-by: _stats_mtx
         self._evloop_metrics = evloop_metrics
         # zero-copy ingress: the slab-ring endpoint starts beside the
         # TCP listener unless the mode (param beats config/env) is off
@@ -512,7 +525,8 @@ class VerifydServer:
         self._shm_mtx = threading.Lock()
         self._shm_endpoint: Optional[shm_transport.ShmEndpoint] = None
         self._grpc = GrpcServer(
-            {VERIFY_PATH: self._handle}, host, port,
+            {VERIFY_PATH: self._handle, STATS_PATH: self._handle_stats},
+            host, port,
             evloop_metrics=evloop_metrics,
         )
         # operator-declared p99 targets (--tenant-slo name=ms): pinned,
@@ -655,6 +669,9 @@ class VerifydServer:
         knobs = sched.resolved_knobs() if sched is not None else None
         with self._stats_mtx:
             return {
+                "shard_id": self.shard_id,
+                "misroutes": self.misroutes,
+                "route_epoch_seen": self.route_epoch_seen,
                 "requests_served": self.requests_served,
                 "admission_rejections": self.admission_rejections,
                 "deadline_expired": self.deadline_expired,
@@ -883,6 +900,7 @@ class VerifydServer:
                 message=message,
                 queue_depth=queue_depth,
                 stages=protocol.pack_stages(stages) if stages else b"",
+                shard_id=self.shard_id,
             )
 
     def _shed(
@@ -963,6 +981,25 @@ class VerifydServer:
             },
         )
 
+    def _handle_stats(self, payload: bytes) -> bytes:
+        """STATS_PATH unary: one JSON snapshot of everything a
+        federation client (or ``verifyd stats``) needs to gossip — wire
+        counters, per-tenant SLO view, brownout level, and this shard's
+        pinned resident-table slice. The request payload is ignored
+        (reserved), so any client version can poll any server version."""
+        del payload
+        from tendermint_tpu.ops import resident
+
+        snap = {
+            "shard_id": self.shard_id,
+            "stats": self.stats(),
+            "tenants": self.tenant_stats(),
+            "brownout": self.brownout.snapshot(),
+            "resident": resident.stats(),
+            "pinned_keys": resident.pinned_keys(),
+        }
+        return json.dumps(snap, sort_keys=True).encode("utf-8")
+
     def _handle(self, payload: bytes) -> bytes:
         """TCP entry point: decode the wire frame, serve, re-encode.
         The shm drain path skips both codec halves and enters
@@ -1021,6 +1058,27 @@ class VerifydServer:
         try:
             kind_name = KIND_NAMES[req.kind]
             klass_name = CLASS_NAMES[req.klass]
+            # federation bookkeeping: a request stamped for another
+            # shard means the client's shard map is stale — serve it
+            # anyway (any shard verifies correctly; only table locality
+            # suffers) but count it and leave a trace breadcrumb
+            if req.route_epoch:
+                with self._stats_mtx:
+                    if req.route_epoch > self.route_epoch_seen:
+                        self.route_epoch_seen = req.route_epoch
+            if (
+                req.shard_id >= 0
+                and self.shard_id >= 0
+                and req.shard_id != self.shard_id
+            ):
+                with self._stats_mtx:
+                    self.misroutes += 1
+                tracing.instant(
+                    "verifyd_misroute",
+                    want=req.shard_id,
+                    got=self.shard_id,
+                    epoch=req.route_epoch,
+                )
             ts = self._tenant_for(req.tenant)
             if req.slo_ms:
                 self._tenant_declare_slo(ts, req.slo_ms)

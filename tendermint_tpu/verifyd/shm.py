@@ -97,7 +97,7 @@ from tendermint_tpu.verifyd.protocol import (
 )
 
 SHM_ENV = "TENDERMINT_TPU_SHM"
-SHM_VERSION = 3  # v3: slo_ms header word (v2: trace words + stage vector)
+SHM_VERSION = 4  # v4: shard/route-epoch words (v3: slo_ms; v2: trace + stages)
 SHM_MAGIC = 0x54_4D_54_50_55_53_4C_42  # "TMTPUSLB"
 
 # per-request lane cap on the slab path; one 2 MiB slab holds an
@@ -133,8 +133,10 @@ SLAB_OFF_TENANT_LEN = 24  # u32, 0 = DEFAULT_TENANT (zero-omission)
 SLAB_OFF_TENANT = 28  # MAX_TENANT_LEN bytes, utf-8, zero-padded
 SLAB_OFF_TRACE = 92  # TraceContext wire form (17B), all-zero = absent
 SLAB_OFF_SLO_MS = 112  # u32 tenant p99 target, 0 = no declared SLO
-SLAB_OFF_GEN2 = 116  # u32 trailing seqlock stamp
-SLAB_HEADER_BYTES = 120
+SLAB_OFF_SHARD_ID = 116  # u32, stored +1; 0 = absent -> -1 (unrouted)
+SLAB_OFF_ROUTE_EPOCH = 120  # u32 routing epoch, 0 = unfederated
+SLAB_OFF_GEN2 = 124  # u32 trailing seqlock stamp
+SLAB_HEADER_BYTES = 128
 
 # the fixed trace-context wire form (tracing.CTX_WIRE_LEN): 8B trace
 # id, 8B span id, 1B flags — stored verbatim so the drain path hands
@@ -193,6 +195,8 @@ def pack_header(
     tenant: str = DEFAULT_TENANT,
     trace: bytes = b"",
     slo_ms: int = 0,
+    shard_id: int = -1,
+    route_epoch: int = 0,
 ) -> None:
     """Publish a slab header. The caller has already written the lane
     table + payload and stamped ``stamp_begin``; this writes every
@@ -224,6 +228,13 @@ def pack_header(
     # reason as trace: 0 decodes as "no declared SLO" (zero-omission,
     # matching protocol field 8)
     struct.pack_into("<I", buf, base + SLAB_OFF_SLO_MS, max(0, slo_ms))
+    # shard id rides the ring +1 (0 = absent -> -1 unrouted) and the
+    # routing epoch as-is (0 = unfederated), the same shifts/omission
+    # defaults protocol fields 9/10 apply on the TCP path
+    struct.pack_into(
+        "<I", buf, base + SLAB_OFF_SHARD_ID, shard_id + 1 if shard_id >= 0 else 0
+    )
+    struct.pack_into("<I", buf, base + SLAB_OFF_ROUTE_EPOCH, max(0, route_epoch))
     # publication order matters: GEN2 first, GEN last — a reader that
     # sees GEN even must also see GEN2 agree, or the slab is torn
     struct.pack_into("<I", buf, base + SLAB_OFF_GEN2, gen)
@@ -245,6 +256,8 @@ def unpack_header(buf, base: int) -> dict:
         buf[base + SLAB_OFF_TRACE : base + SLAB_OFF_TRACE + _TRACE_WIRE_LEN]
     )
     (slo_ms,) = struct.unpack_from("<I", buf, base + SLAB_OFF_SLO_MS)
+    (shard_raw,) = struct.unpack_from("<I", buf, base + SLAB_OFF_SHARD_ID)
+    (route_epoch,) = struct.unpack_from("<I", buf, base + SLAB_OFF_ROUTE_EPOCH)
     (gen2,) = struct.unpack_from("<I", buf, base + SLAB_OFF_GEN2)
     if gen % 2 == 1 or gen != gen2:
         raise ValueError(f"torn slab: generation {gen}/{gen2}")
@@ -263,6 +276,10 @@ def unpack_header(buf, base: int) -> dict:
         raise ValueError(f"tenant name too long: {tenant_len}")
     if slo_ms > protocol.MAX_SLO_MS:
         raise ValueError(f"slo_ms too large: {slo_ms}")
+    if shard_raw > protocol.MAX_SHARD_ID + 1:
+        raise ValueError(f"shard id too large: {shard_raw - 1}")
+    if route_epoch > protocol.MAX_ROUTE_EPOCH:
+        raise ValueError(f"route epoch too large: {route_epoch}")
     if tenant_len:
         raw = bytes(buf[base + SLAB_OFF_TENANT : base + SLAB_OFF_TENANT + tenant_len])
         tenant = raw.decode("utf-8", "replace")
@@ -280,6 +297,10 @@ def unpack_header(buf, base: int) -> dict:
         # the same empty default decode_request applies
         "trace": raw_trace if any(raw_trace[:8]) else b"",
         "slo_ms": slo_ms,
+        # 0 = absent (zeroed/old header) -> the same -1 "unrouted"
+        # default request field 9 decodes to
+        "shard_id": shard_raw - 1 if shard_raw else -1,
+        "route_epoch": route_epoch,
     }
 
 
@@ -744,6 +765,8 @@ class _ShmSession:
             tenant=hdr["tenant"],
             trace=hdr["trace"],
             slo_ms=hdr["slo_ms"],
+            shard_id=hdr["shard_id"],
+            route_epoch=hdr["route_epoch"],
         )
         # lanes are now the scheduler's problem; they stop counting as
         # ring backlog the moment the serve path (admission included)
@@ -1204,6 +1227,8 @@ class ShmClientTransport:
             tenant=req.tenant,
             trace=req.trace,
             slo_ms=req.slo_ms,
+            shard_id=req.shard_id,
+            route_epoch=req.route_epoch,
         )
 
     def _send_commit(self, seq: int, slot: int, lanes: int) -> None:

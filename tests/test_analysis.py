@@ -816,6 +816,74 @@ class TestWireVarintZeroOmission:
         assert codes(found) == ["TPW001"]
         assert "KIND_RAW" in found[0].message
 
+    def test_route_epoch_without_reestablishment_flagged(self):
+        # dirty twin of the federation routing-epoch field (protocol
+        # field 10): zero-omitted on encode, so a pre-federation frame
+        # (field absent) MUST decode back to exactly 0 — a decoder that
+        # only assigns what it read re-encodes absent as present-zero
+        src = """
+            def encode_varint_field(field, v):
+                return b""
+
+            def encode(req):
+                out = b""
+                if req.route_epoch:
+                    out += encode_varint_field(10, req.route_epoch)
+                return out
+
+            def decode(r, req):
+                req.route_epoch = r.read_varint()
+                return req
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPW004"]
+        assert "route_epoch" in found[0].message
+
+    def test_route_epoch_with_or_zero_passes(self):
+        # clean twin: the real protocol.py shape — explicit `or 0`
+        # re-establishment after the read
+        src = """
+            def encode_varint_field(field, v):
+                return b""
+
+            def encode(req):
+                out = b""
+                if req.route_epoch:
+                    out += encode_varint_field(10, req.route_epoch)
+                return out
+
+            def decode(r, req):
+                req.route_epoch = r.read_varint()
+                req.route_epoch = req.route_epoch or 0
+                return req
+        """
+        assert self.run(src) == []
+
+    def test_shifted_shard_id_emit_stays_clean(self):
+        # the federation shard-id field (protocol field 9) rides a +1
+        # wire shift under a `>= 0` guard so shard 0 survives zero
+        # omission; the shifted emit is not a raw-attr emit, so neither
+        # the varint zero-omission leg nor the enum-default leg may
+        # misread it as an unguarded field
+        src = """
+            class VerifyRequest:
+                shard_id: int = -1
+
+            def encode_varint_field(field, v):
+                return b""
+
+            def encode(req):
+                out = b""
+                if req.shard_id >= 0:
+                    out += encode_varint_field(9, req.shard_id + 1)
+                return out
+
+            def decode(r, req):
+                req.shard_id = r.read_varint() - 1
+                return req
+        """
+        assert self.run(src) == []
+
 
 SLAB_DIRTY = """
     SLAB_OFF_GEN = 0
@@ -906,6 +974,29 @@ class TestSlabHeaderSymmetry:
             {"tendermint_tpu/verifyd/protocol.py": "KIND_RAW = 1\n"},
         )
         assert found == []
+
+    def test_v4_routing_slot_unpacked_but_never_packed_flagged(self):
+        # dirty twin of the slab-header v4 federation slots: a reader
+        # that learns SLAB_OFF_ROUTE_EPOCH while the writer never
+        # stamps it would ship uninitialized slab bytes as an epoch
+        src = """
+            SLAB_OFF_GEN = 0
+            SLAB_OFF_SHARD_ID = 116
+            SLAB_OFF_ROUTE_EPOCH = 120
+
+            def pack_header(buf, base, gen, shard_id):
+                struct.pack_into("<I", buf, base + SLAB_OFF_SHARD_ID, shard_id + 1)
+                struct.pack_into("<I", buf, base + SLAB_OFF_GEN, gen)
+
+            def unpack_header(buf, base):
+                (gen,) = struct.unpack_from("<I", buf, base + SLAB_OFF_GEN)
+                (raw,) = struct.unpack_from("<I", buf, base + SLAB_OFF_SHARD_ID)
+                (epoch,) = struct.unpack_from("<I", buf, base + SLAB_OFF_ROUTE_EPOCH)
+                return gen, raw - 1, epoch
+        """
+        found = self.run(src)
+        assert codes(found) == ["TPW005"]
+        assert "SLAB_OFF_ROUTE_EPOCH" in found[0].message
 
     def test_real_shm_module_is_clean(self):
         import pathlib

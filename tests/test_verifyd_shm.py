@@ -62,7 +62,7 @@ class TestSlabHeader:
             buf, 0, gen=4, kind=protocol.KIND_COMMIT,
             klass=protocol.CLASS_LIGHT, deadline_ms=250,
             algo=protocol.ALGO_SR25519, lanes=17, tenant="chain-a",
-            slo_ms=75,
+            slo_ms=75, shard_id=3, route_epoch=9,
         )
         hdr = shm.unpack_header(buf, 0)
         assert hdr == {
@@ -71,7 +71,28 @@ class TestSlabHeader:
             "algo": protocol.ALGO_SR25519, "lanes": 17, "tenant": "chain-a",
             "trace": b"",  # omitted context decodes to the empty default
             "slo_ms": 75,
+            "shard_id": 3, "route_epoch": 9,
         }
+
+    def test_omitted_shard_decodes_to_unrouted(self):
+        """A zeroed/old header carries no shard id or routing epoch —
+        the same zero-omission defaults the omitted protocol fields
+        9/10 decode to (-1 unrouted, epoch 0), and slab reuse must not
+        leak the previous occupant's routing."""
+        buf = self._buf()
+        shm.pack_header(
+            buf, 0, gen=2, kind=protocol.KIND_RAW,
+            klass=protocol.CLASS_RPC, deadline_ms=0,
+            algo=protocol.ALGO_ED25519, lanes=1, shard_id=2, route_epoch=5,
+        )
+        shm.pack_header(
+            buf, 0, gen=4, kind=protocol.KIND_RAW,
+            klass=protocol.CLASS_RPC, deadline_ms=0,
+            algo=protocol.ALGO_ED25519, lanes=1,
+        )
+        hdr = shm.unpack_header(buf, 0)
+        assert hdr["shard_id"] == -1
+        assert hdr["route_epoch"] == 0
 
     def test_omitted_slo_decodes_to_zero(self):
         """A zeroed/old header carries no SLO — same zero-omission
