@@ -24,6 +24,7 @@ from scripts.analysis.hygiene import HygieneChecker
 from scripts.analysis.jaxpurity import JaxPurityChecker
 from scripts.analysis.locks import LockDisciplineChecker
 from scripts.analysis.metrics_checks import MetricsChecker
+from scripts.analysis.taint import TaintChecker
 from scripts.analysis.wire import WireCompatChecker
 
 #: registration order is report order for equal path:line
@@ -33,6 +34,7 @@ CHECKERS: List[Type[Checker]] = [
     WireCompatChecker,
     HygieneChecker,
     MetricsChecker,
+    TaintChecker,
 ]
 
 

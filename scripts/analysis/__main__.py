@@ -116,7 +116,10 @@ def main(argv: List[str] = None) -> int:
         print(f"tpulint: {n} finding(s), baseline ignored")
         return 1 if findings else 0
 
-    baseline = load_baseline(args.baseline)
+    pruned: List[str] = []
+    baseline = load_baseline(args.baseline, pruned=pruned)
+    for key in pruned:
+        print(f"tpulint: note: pruned baseline entry for deleted file: {key}")
     new, stale = diff_baseline(findings, baseline)
     for f in new:
         print(f.render())

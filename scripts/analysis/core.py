@@ -205,15 +205,35 @@ def load_modules(roots: Sequence[str], repo_root: str = REPO_ROOT) -> List[Modul
 # --- baseline ----------------------------------------------------------------
 
 
-def load_baseline(path: str) -> "_Counter[str]":
+def load_baseline(
+    path: str,
+    repo_root: str = REPO_ROOT,
+    pruned: Optional[List[str]] = None,
+) -> "_Counter[str]":
+    """Load the baseline multiset, dropping entries for deleted files.
+
+    ``--update-baseline`` used to leave keys for files that no longer
+    exist as permanent dead weight (they never match a finding, so they
+    are never reported stale by normal runs against default paths, and
+    they survive every refresh of an unrelated subtree). Each key embeds
+    its repo-relative path before the first ``: ``, so prune any whose
+    file is gone; callers that pass ``pruned`` get the dropped keys back
+    to surface as a note.
+    """
     counts: "_Counter[str]" = _Counter()
     if not os.path.exists(path):
         return counts
     with open(path, "r", encoding="utf-8") as fh:
         for line in fh:
             line = line.strip()
-            if line and not line.startswith("#"):
-                counts[line] += 1
+            if not line or line.startswith("#"):
+                continue
+            rel = line.split(": ", 1)[0]
+            if not os.path.exists(os.path.join(repo_root, rel)):
+                if pruned is not None:
+                    pruned.append(line)
+                continue
+            counts[line] += 1
     return counts
 
 

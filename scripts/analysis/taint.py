@@ -1,0 +1,1174 @@
+"""tpuflow (TPT): interprocedural taint analysis for untrusted wire input.
+
+Every past wire-parsing bug in this repo was the same shape: an
+*untrusted decoded integer reached a dangerous sink without a bounds
+guard* — the garbage lane count that could run ``struct.unpack_from``
+off the segment, the advert-spoof verdict-forgery vector, the slab
+bookkeeping corruption. The five existing checker families are
+syntactic; none of them track dataflow, so none of them can see that
+class. This checker does.
+
+Taint SOURCES are the repo's decode surfaces (``SURFACE_SUFFIXES``):
+the varint/field readers of ``encoding/proto.py`` used by
+``verifyd/protocol.py``, ``struct.unpack``/``unpack_from`` and frame
+reads in ``verifyd/shm.py`` and ``libs/grpc.py`` (plus
+``int.from_bytes`` length fields), ``json.loads`` bodies in
+``rpc/server.py``, and the gossip ``server_stats`` snapshots
+``verifyd/federation.py`` merges. Any value produced by one of those
+calls inside a surface module is tainted.
+
+Taint PROPAGATES through assignments, arithmetic, tuple unpacking,
+f-strings, container literals, dataclass/attribute stores (including
+``self.X`` — a method that stores tainted data into an attribute
+taints that attribute for every method of the class), and across
+function calls: summaries record whether a function *returns* tainted
+data (per attribute / per constant dict key, so ``decode_request``'s
+guarded fields come back clean while unguarded ones stay hot) and
+which *parameters* are tainted at any call site, iterated to a fixed
+point over the same import-alias call-graph machinery jaxpurity uses.
+
+Taint is CLEARED only by:
+
+- a dominating range guard — a comparison of the tainted name (or its
+  ``len()``) against an untainted bound inside an ``if``/``assert``
+  whose failing branch raises/returns, or membership tests like
+  ``if kind not in KIND_NAMES: raise``;
+- a clamp — ``x = min(x, LIMIT)``, ``x % N``, ``x & MASK``;
+- an explicit ``# tpuflow: sanitized=<reason>`` annotation on the
+  statement line, for bounds the analysis cannot see (e.g. enforced
+  inside a helper). Annotations are themselves audited: one that never
+  clears any taint is reported stale (TPT004).
+
+Report codes:
+
+- TPT001 — unguarded tainted length/size/index at a sink: allocation
+  sizes (``bytearray(n)``, ``recv(n)``, ``b"x" * n``), slice/index
+  bounds, ``struct.unpack``/``unpack_from`` offsets and tainted format
+  counts, ``pack_into`` offsets.
+- TPT002 — tainted value used as a loop/blocking bound: ``range(n)``,
+  ``while`` tests, ``.wait(timeout=n)``, ``time.sleep(n)``,
+  ``settimeout(n)`` — the "huge deadline pins a worker forever" class.
+- TPT003 — tainted key grows an unbounded mapping (tenant/shard label
+  maps): ``d[tainted] = v`` / ``d.setdefault(tainted, ...)`` on a
+  known dict.
+- TPT004 — stale ``tpuflow`` annotation: the annotated statement
+  carries no taint to clear (the code changed under the comment), or
+  the annotation is malformed (no ``=<reason>``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from scripts.analysis.core import (
+    Checker,
+    Finding,
+    Module,
+    Project,
+    dotted_name,
+)
+
+# Decode-surface modules: taint originates here and only here. Other
+# modules still participate in propagation (a tainted return value or
+# argument carries into them), but their own unpack/json calls operate
+# on trusted, locally-produced data and stay clean.
+SURFACE_SUFFIXES = (
+    "tendermint_tpu/encoding/proto.py",
+    "tendermint_tpu/verifyd/protocol.py",
+    "tendermint_tpu/verifyd/shm.py",
+    "tendermint_tpu/verifyd/client.py",
+    "tendermint_tpu/verifyd/federation.py",
+    "tendermint_tpu/libs/grpc.py",
+    "tendermint_tpu/rpc/server.py",
+)
+
+# terminal attribute names whose CALL result is tainted in a surface
+# module: the proto Reader cursor methods, struct unpacking, network
+# length fields, JSON bodies, and gossip snapshots
+_READ_CALLS = {
+    "read_varint", "read_svarint", "read_bytes",
+    "read_fixed32", "read_fixed64", "read_sfixed64",
+}
+_UNPACK_CALLS = {"unpack", "unpack_from"}
+_SOURCE_ATTR_CALLS = _READ_CALLS | _UNPACK_CALLS | {"server_stats"}
+
+# allocation-ish callees: a tainted size argument is TPT001
+_ALLOC_CALLS = {
+    "bytearray", "recv", "recv_into", "read", "readexactly", "zeros",
+    "empty",
+}
+# blocking-ish callees: a tainted timeout/count argument is TPT002
+_BLOCK_CALLS = {"wait", "sleep", "settimeout", "acquire", "join"}
+
+# builtins that launder taint away (result is host-controlled)
+_CLEAN_CALLS = {
+    "len", "bool", "isinstance", "hasattr", "id", "type", "repr",
+    "format", "hash", "callable", "time", "monotonic", "perf_counter",
+}
+# builtins/conversions that pass taint through unchanged
+_PASS_CALLS = {
+    "int", "float", "str", "bytes", "abs", "round", "sum", "max",
+    "sorted", "reversed", "list", "tuple", "set", "frozenset", "zip",
+    "enumerate", "iter", "next", "bytearray", "memoryview", "dict",
+}
+
+_ANNOT_RE = re.compile(r"tpuflow:\s*sanitized\s*=\s*(\S.*)")
+_ANNOT_ANY_RE = re.compile(r"tpuflow:")
+
+#: the value-itself taint marker inside a slot set (other members are
+#: tainted attribute / constant-key names of the bound object)
+SELF_TAINT = ""
+
+_MAX_ITERATIONS = 10
+
+
+def _is_surface(rel: str) -> bool:
+    return any(rel.endswith(suf) for suf in SURFACE_SUFFIXES)
+
+
+class _FnInfo:
+    """One analyzable function/method."""
+
+    __slots__ = ("module", "node", "qualname", "cls", "params")
+
+    def __init__(self, module: Module, node: ast.AST, qualname: str,
+                 cls: Optional[str]):
+        self.module = module
+        self.node = node
+        self.qualname = qualname
+        self.cls = cls  # enclosing class name or None
+        args = node.args
+        self.params: List[str] = [
+            a.arg for a in (
+                list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs)
+            )
+        ]
+        if args.vararg:
+            self.params.append(args.vararg.arg)
+        if args.kwarg:
+            self.params.append(args.kwarg.arg)
+
+
+class _Summary:
+    """Cross-call facts about one function, grown monotonically."""
+
+    __slots__ = ("param_taint", "returns", "return_attrs")
+
+    def __init__(self):
+        self.param_taint: Dict[str, Set[str]] = {}  # param name -> slots
+        self.returns = False  # return value itself tainted
+        self.return_attrs: Set[str] = set()  # tainted attrs/keys of return
+
+    def merge_param(self, name: str, slots: Set[str]) -> bool:
+        if not slots:
+            return False
+        cur = self.param_taint.setdefault(name, set())
+        before = len(cur)
+        cur |= slots
+        return len(cur) != before
+
+
+class TaintChecker(Checker):
+    name = "taint"
+    codes = {
+        "TPT001": "unguarded tainted length/size/index reaches an "
+                  "allocation, slice, or struct-offset sink",
+        "TPT002": "tainted value used as a loop or blocking bound",
+        "TPT003": "tainted key grows an unbounded mapping",
+        "TPT004": "stale or malformed 'tpuflow: sanitized=' annotation",
+    }
+
+    # --- project pass ---------------------------------------------------------
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        if not any(_is_surface(m.rel) for m in project.modules):
+            return
+        self._fns: Dict[Tuple[str, str], _FnInfo] = {}
+        self._by_name: Dict[str, List[Tuple[str, str]]] = {}
+        self._aliases: Dict[str, Dict[str, str]] = {}
+        self._from_imports: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        self._ext_imports: Dict[str, Set[str]] = {}
+        self._dataclasses: Set[str] = set()
+        self._class_attr_taint: Dict[Tuple[str, str], Dict[str, Set[str]]] = {}
+        self._class_dict_attrs: Dict[Tuple[str, str], Set[str]] = {}
+        self._summaries: Dict[Tuple[str, str], _Summary] = {}
+        self._used_annotations: Set[Tuple[str, int]] = set()
+        self._index(project)
+
+        # fixed point: param taint and return summaries grow monotonically
+        for _ in range(_MAX_ITERATIONS):
+            self._changed = False
+            for key in sorted(self._fns):
+                self._analyze(key, emit=None)
+            if not self._changed:
+                break
+
+        findings: List[Finding] = []
+        for key in sorted(self._fns):
+            self._analyze(key, emit=findings)
+        findings.extend(self._annotation_findings(project))
+        seen = set()
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.code,
+                                                 f.message)):
+            k = (f.path, f.line, f.code, f.message)
+            if k not in seen:
+                seen.add(k)
+                yield f
+
+    # --- indexing -------------------------------------------------------------
+
+    def _index(self, project: Project) -> None:
+        stems = {
+            m.rel.rsplit("/", 1)[-1][:-3]: m.rel for m in project.modules
+        }
+        for mod in project.modules:
+            self._aliases[mod.rel] = {}
+            self._from_imports[mod.rel] = {}
+            ext = self._ext_imports.setdefault(mod.rel, set())
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    tail = node.module.rsplit(".", 1)[-1]
+                    for alias in node.names:
+                        if alias.name in stems:
+                            # from pkg import module [as alias]
+                            self._aliases[mod.rel][
+                                alias.asname or alias.name
+                            ] = stems[alias.name]
+                        elif tail in stems:
+                            # from pkg.module import name [as alias]
+                            self._from_imports[mod.rel][
+                                alias.asname or alias.name
+                            ] = (stems[tail], alias.name)
+                        else:
+                            ext.add(alias.asname or alias.name)
+                elif isinstance(node, ast.Import):
+                    for alias in node.names:
+                        stem = alias.name.rsplit(".", 1)[-1]
+                        if stem in stems:
+                            self._aliases[mod.rel][
+                                alias.asname or stem
+                            ] = stems[stem]
+                        else:
+                            ext.add(
+                                (alias.asname or alias.name).split(".")[0]
+                            )
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add_fn(mod, node, node.name, None)
+                elif isinstance(node, ast.ClassDef):
+                    decs = {
+                        (dotted_name(d.func if isinstance(d, ast.Call) else d)
+                         or "").rsplit(".", 1)[-1]
+                        for d in node.decorator_list
+                    }
+                    if "dataclass" in decs:
+                        self._dataclasses.add(node.name)
+                    ckey = (mod.rel, node.name)
+                    self._class_attr_taint.setdefault(ckey, {})
+                    dict_attrs = self._class_dict_attrs.setdefault(ckey, set())
+                    for sub in node.body:
+                        if isinstance(sub,
+                                      (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            self._add_fn(
+                                mod, sub, f"{node.name}.{sub.name}", node.name
+                            )
+                            for st in ast.walk(sub):
+                                tgt = None
+                                if isinstance(st, ast.Assign) and st.targets:
+                                    tgt = st.targets[0]
+                                elif isinstance(st, ast.AnnAssign):
+                                    tgt = st.target
+                                if (
+                                    tgt is not None
+                                    and isinstance(tgt, ast.Attribute)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and tgt.value.id == "self"
+                                    and self._is_dict_expr(
+                                        getattr(st, "value", None))
+                                ):
+                                    dict_attrs.add(tgt.attr)
+
+    def _add_fn(self, mod: Module, node, qualname: str,
+                cls: Optional[str]) -> None:
+        key = (mod.rel, qualname)
+        self._fns[key] = _FnInfo(mod, node, qualname, cls)
+        self._summaries[key] = _Summary()
+        self._by_name.setdefault(qualname.rsplit(".", 1)[-1], []).append(key)
+
+    @staticmethod
+    def _is_dict_expr(expr) -> bool:
+        if isinstance(expr, ast.Dict):
+            return True
+        if isinstance(expr, ast.Call):
+            callee = dotted_name(expr.func) or ""
+            return callee.rsplit(".", 1)[-1] in (
+                "dict", "defaultdict", "OrderedDict", "Counter"
+            )
+        return False
+
+    # --- call resolution ------------------------------------------------------
+
+    def _resolve_call(self, mod_rel: str, cls: Optional[str],
+                      call: ast.Call) -> Optional[Tuple[str, str]]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            key = (mod_rel, fn.id)
+            if key in self._fns:
+                return key
+            imp = self._from_imports.get(mod_rel, {}).get(fn.id)
+            if imp and imp in self._fns:
+                return imp
+            return None
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name):
+                base = fn.value.id
+                if base == "self" and cls:
+                    key = (mod_rel, f"{cls}.{fn.attr}")
+                    if key in self._fns:
+                        return key
+                target_mod = self._aliases.get(mod_rel, {}).get(base)
+                if target_mod:
+                    key = (target_mod, fn.attr)
+                    if key in self._fns:
+                        return key
+                    # module-level alias to a class method never resolves
+                    return None
+                if base in self._ext_imports.get(mod_rel, ()):
+                    # a call through an external module (dataclasses.
+                    # fields, struct.unpack, ...) must never
+                    # unique-resolve to a same-named repo method
+                    return None
+            # method call on an arbitrary object: resolve only when the
+            # method name is globally unique (same trade jaxpurity makes
+            # for simple-name calls — precision bounded by honesty)
+            candidates = [
+                k for k in self._by_name.get(fn.attr, ())
+                if "." in k[1]
+            ] or self._by_name.get(fn.attr, [])
+            if len(candidates) == 1:
+                return candidates[0]
+        return None
+
+    # --- per-function analysis ------------------------------------------------
+
+    def _analyze(self, key: Tuple[str, str], emit) -> None:
+        info = self._fns[key]
+        summary = self._summaries[key]
+        state: Dict[str, Set[str]] = {}
+        for p, slots in summary.param_taint.items():
+            state[p] = set(slots)
+        walker = _FnWalker(self, info, state, emit)
+        walker.run()
+        if walker.returns_taint and not summary.returns:
+            summary.returns = True
+            self._changed = True
+        new_attrs = walker.return_attrs - summary.return_attrs
+        if new_attrs:
+            summary.return_attrs |= new_attrs
+            self._changed = True
+
+    # --- annotation audit -----------------------------------------------------
+
+    def _annotation_findings(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            for line, text in sorted(mod.comments.items()):
+                if not _ANNOT_ANY_RE.search(text):
+                    continue
+                m = _ANNOT_RE.search(text)
+                if not m:
+                    out.append(Finding(
+                        mod.rel, line, "TPT004",
+                        "malformed tpuflow annotation (expected "
+                        "'# tpuflow: sanitized=<reason>')",
+                    ))
+                elif (mod.rel, line) not in self._used_annotations:
+                    out.append(Finding(
+                        mod.rel, line, "TPT004",
+                        "stale tpuflow annotation: no tainted value "
+                        "reaches this statement (drop the comment or "
+                        "restore the guard it documented)",
+                    ))
+        return out
+
+
+class _FnWalker:
+    """Statement-ordered abstract interpretation of one function body.
+
+    ``state`` maps a name (``"x"`` or one-level dotted ``"req.kind"``)
+    to its tainted *slots*: ``SELF_TAINT`` ("") means the value itself,
+    other members are tainted attribute/constant-key names of the bound
+    object. Emits findings when ``emit`` is a list (final pass), and
+    always feeds callee param taint + class-attr taint back into the
+    checker for the fixed point.
+    """
+
+    def __init__(self, checker: TaintChecker, info: _FnInfo,
+                 state: Dict[str, Set[str]], emit):
+        self.c = checker
+        self.info = info
+        self.mod = info.module
+        self.state = state
+        self.emit = emit
+        self.returns_taint = False
+        self.return_attrs: Set[str] = set()
+        self.dict_names: Set[str] = set()
+        self.capped_dicts: Set[str] = set()
+        self._nested: Set[ast.AST] = set()
+        for sub in ast.walk(info.node):
+            if sub is not info.node and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                self._nested.add(sub)
+                self._nested.update(ast.walk(sub))
+
+    # -- driver ---------------------------------------------------------------
+
+    def run(self) -> None:
+        for stmt in self.info.node.body:
+            self._exec(stmt)
+
+    def _report(self, node: ast.AST, code: str, message: str) -> None:
+        annot = self._annotated(node)
+        if annot is not None:
+            # the sink is annotated as sanitized-elsewhere: suppress,
+            # and record the annotation as live (not TPT004-stale)
+            self._use_annotation(annot)
+            return
+        if self.emit is not None:
+            self.emit.append(Finding(
+                self.mod.rel, getattr(node, "lineno", 1), code, message
+            ))
+
+    # -- taint state helpers --------------------------------------------------
+
+    def _slots(self, name: str) -> Set[str]:
+        return self.state.get(name, set())
+
+    def _set(self, name: str, slots: Set[str]) -> None:
+        if slots:
+            self.state[name] = set(slots)
+        else:
+            self.state.pop(name, None)
+
+    def _clear(self, name: str) -> None:
+        self.state.pop(name, None)
+        if "." in name:
+            # clearing req.kind removes the slot from req as well
+            base, attr = name.split(".", 1)
+            slots = self.state.get(base)
+            if slots:
+                slots.discard(attr)
+                if not slots:
+                    del self.state[base]
+
+    def _annotated(self, stmt: ast.AST) -> Optional[int]:
+        """Line of the ``tpuflow: sanitized=`` annotation covering this
+        statement: trailing on the same line, or in the contiguous
+        comment block immediately above it. None when unannotated."""
+        line = getattr(stmt, "lineno", -1)
+        if _ANNOT_RE.search(self.mod.comment_on(line)):
+            return line
+        prev = line - 1
+        while prev > 0 and prev in self.mod.comments:
+            if _ANNOT_RE.search(self.mod.comments[prev]):
+                return prev
+            prev -= 1
+        return None
+
+    def _use_annotation(self, annot_line: int) -> None:
+        self.c._used_annotations.add((self.mod.rel, annot_line))
+
+    # -- expression evaluation ------------------------------------------------
+
+    def _eval(self, expr) -> Set[str]:
+        """Tainted slots of an expression's value (findings emitted for
+        sinks encountered along the way)."""
+        if expr is None or isinstance(expr, ast.Constant):
+            return set()
+        if isinstance(expr, ast.Name):
+            return set(self._slots(expr.id))
+        if isinstance(expr, ast.Attribute):
+            return self._eval_attribute(expr)
+        if isinstance(expr, ast.Subscript):
+            return self._eval_subscript(expr, store=False)
+        if isinstance(expr, ast.Call):
+            return self._eval_call(expr)
+        if isinstance(expr, ast.BinOp):
+            self._check_mult_alloc(expr)
+            out = self._eval(expr.left) | self._eval(expr.right)
+            if isinstance(expr.op, (ast.Mod, ast.BitAnd)) and not self._eval(
+                expr.right
+            ):
+                return set()  # x % N / x & MASK clamps to a host bound
+            return out
+        if isinstance(expr, ast.UnaryOp):
+            return self._eval(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            out: Set[str] = set()
+            for v in expr.values:
+                out |= self._eval(v)
+            return out
+        if isinstance(expr, ast.Compare):
+            self._eval(expr.left)
+            for comp in expr.comparators:
+                self._eval(comp)
+            return set()  # a bool is never a size
+        if isinstance(expr, ast.IfExp):
+            self._eval(expr.test)
+            return self._eval(expr.body) | self._eval(expr.orelse)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out = set()
+            for el in expr.elts:
+                if isinstance(el, ast.Starred):
+                    el = el.value
+                if self._eval(el):
+                    out.add(SELF_TAINT)
+            return out
+        if isinstance(expr, ast.Dict):
+            out = set()
+            for k, v in zip(expr.keys, expr.values):
+                vt = self._eval(v)
+                if k is not None:
+                    self._eval(k)
+                if vt:
+                    if (
+                        isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                    ):
+                        out.add(k.value)
+                    else:
+                        out.add(SELF_TAINT)
+            return out
+        if isinstance(expr, ast.JoinedStr):
+            out = set()
+            for v in expr.values:
+                if isinstance(v, ast.FormattedValue) and self._eval(v.value):
+                    out.add(SELF_TAINT)
+            return out
+        if isinstance(expr, ast.FormattedValue):
+            return self._eval(expr.value)
+        if isinstance(expr, ast.Starred):
+            return self._eval(expr.value)
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            return self._eval_comprehension(expr)
+        if isinstance(expr, ast.Lambda):
+            self._eval(expr.body)
+            return set()
+        if isinstance(expr, ast.Slice):
+            out = set()
+            for part in (expr.lower, expr.upper, expr.step):
+                if part is not None:
+                    out |= self._eval(part)
+            return out
+        if isinstance(expr, ast.Await):
+            return self._eval(expr.value)
+        if isinstance(expr, (ast.Yield, ast.YieldFrom)):
+            slots = self._eval(expr.value) if expr.value else set()
+            if slots:
+                # a generator whose yields are tainted taints every
+                # loop that iterates it (Reader.fields and friends)
+                self.returns_taint = True
+            return slots
+        if isinstance(expr, ast.NamedExpr):
+            slots = self._eval(expr.value)
+            self._set(expr.target.id, slots)
+            return slots
+        return set()
+
+    def _eval_attribute(self, expr: ast.Attribute) -> Set[str]:
+        dotted = dotted_name(expr)
+        if dotted:
+            direct = self._slots(dotted)
+            if direct:
+                return set(direct)
+            base = dotted.rsplit(".", 1)[0]
+            base_slots = self._slots(base)
+            if SELF_TAINT in base_slots or expr.attr in base_slots:
+                return {SELF_TAINT}
+            if base == "self" and self.info.cls:
+                cat = self.c._class_attr_taint.get(
+                    (self.mod.rel, self.info.cls), {}
+                )
+                slots = cat.get(expr.attr)
+                if slots:
+                    return set(slots)
+            return set()
+        inner = self._eval(expr.value)
+        return {SELF_TAINT} if SELF_TAINT in inner or expr.attr in inner \
+            else set()
+
+    def _eval_subscript(self, expr: ast.Subscript, store: bool) -> Set[str]:
+        recv = dotted_name(expr.value) or ""
+        recv_slots = self._eval(expr.value)
+        idx = expr.slice
+        idx_slots = self._eval(idx)
+        is_dict = self._is_known_dict(recv)
+        if idx_slots:
+            if is_dict:
+                if store and not self._dict_capped(recv):
+                    self._report(
+                        expr, "TPT003",
+                        f"tainted key grows mapping '{recv or '<expr>'}' "
+                        "with no cardinality guard (cap entries or guard "
+                        "the key before insertion)",
+                    )
+            else:
+                what = "index/slice bound" if not isinstance(idx, ast.Slice) \
+                    else "slice bound"
+                self._report(
+                    expr, "TPT001",
+                    f"tainted {what} into '{recv or '<expr>'}' without a "
+                    "dominating range guard",
+                )
+        if SELF_TAINT in recv_slots:
+            return {SELF_TAINT}
+        if (
+            isinstance(idx, ast.Constant) and isinstance(idx.value, str)
+            and idx.value in recv_slots
+        ):
+            return {SELF_TAINT}
+        return set()
+
+    def _eval_comprehension(self, expr) -> Set[str]:
+        saved = {}
+        for gen in expr.generators:
+            it = self._eval(gen.iter)
+            for name in _target_names(gen.target):
+                saved.setdefault(name, self.state.get(name))
+                self._set(name, {SELF_TAINT} if it else set())
+            for cond in gen.ifs:
+                self._eval(cond)
+            self._check_range_loop(gen.iter, expr)
+        if isinstance(expr, ast.DictComp):
+            kt = self._eval(expr.key)
+            vt = self._eval(expr.value)
+            out = {SELF_TAINT} if (kt or vt) else set()
+        else:
+            out = {SELF_TAINT} if self._eval(expr.elt) else set()
+        for name, old in saved.items():
+            if old is None:
+                self.state.pop(name, None)
+            else:
+                self.state[name] = old
+        return out
+
+    # -- calls ----------------------------------------------------------------
+
+    def _eval_call(self, call: ast.Call) -> Set[str]:
+        callee = dotted_name(call.func) or ""
+        terminal = callee.rsplit(".", 1)[-1]
+        arg_slots = [self._eval(a) for a in call.args]
+        kw_slots = {
+            kw.arg: self._eval(kw.value) for kw in call.keywords
+        }
+        any_taint = any(arg_slots) or any(kw_slots.values())
+
+        self._check_call_sinks(call, terminal, arg_slots, kw_slots)
+
+        # sources (surface modules only)
+        if _is_surface(self.mod.rel):
+            if (
+                isinstance(call.func, ast.Attribute)
+                and terminal in _SOURCE_ATTR_CALLS
+            ):
+                return {SELF_TAINT}
+            if callee in ("int.from_bytes", "json.loads"):
+                return {SELF_TAINT}
+
+        # interprocedural: push arg taint into the callee, pull summary
+
+        target = self.c._resolve_call(self.mod.rel, self.info.cls, call)
+        if target is not None:
+            self._push_args(target, call, arg_slots, kw_slots)
+            summ = self.c._summaries[target]
+            out: Set[str] = set()
+            if summ.returns:
+                out.add(SELF_TAINT)
+            out |= summ.return_attrs
+            if out:
+                return out
+
+        # dataclass construction with tainted kwargs -> per-attr taint
+        if (
+            isinstance(call.func, ast.Name)
+            and call.func.id in self.c._dataclasses
+        ):
+            return {
+                kw.arg for kw in call.keywords
+                if kw.arg and kw_slots.get(kw.arg)
+            }
+
+        if terminal == "min" and len(call.args) > 1:
+            # min(x, LIMIT) bounds the result iff any operand is clean
+            if not all(arg_slots):
+                return set()
+            return {SELF_TAINT}
+        if terminal in _CLEAN_CALLS:
+            return set()
+        if terminal in _PASS_CALLS or terminal in ("get", "pop", "copy",
+                                                   "items", "values", "keys",
+                                                   "setdefault", "decode",
+                                                   "encode", "split",
+                                                   "strip", "join"):
+            return {SELF_TAINT} if any_taint or self._recv_taint(call) \
+                else set()
+        return set()
+
+    def _recv_taint(self, call: ast.Call) -> bool:
+        """d.get("k") on a tainted container yields tainted data."""
+        if isinstance(call.func, ast.Attribute):
+            return bool(self._eval(call.func.value))
+        return False
+
+    def _push_args(self, target: Tuple[str, str], call: ast.Call,
+                   arg_slots, kw_slots) -> None:
+        callee = self.c._fns[target]
+        summ = self.c._summaries[target]
+        params = list(callee.params)
+        if params and params[0] == "self" and isinstance(
+            call.func, ast.Attribute
+        ):
+            params = params[1:]
+        for i, slots in enumerate(arg_slots):
+            if slots and i < len(params):
+                if summ.merge_param(params[i], slots):
+                    self.c._changed = True
+        for name, slots in kw_slots.items():
+            if slots and name in callee.params:
+                if summ.merge_param(name, slots):
+                    self.c._changed = True
+
+    def _check_call_sinks(self, call: ast.Call, terminal: str,
+                          arg_slots, kw_slots) -> None:
+        tainted_pos = [i for i, s in enumerate(arg_slots) if s]
+        tainted_kw = [k for k, s in kw_slots.items() if s]
+        if terminal in _UNPACK_CALLS or terminal == "pack_into":
+            # only a tainted OFFSET or a tainted format COUNT walks the
+            # cursor off the buffer — a tainted packed value or a
+            # tainted source buffer is the normal decode shape. The
+            # module functions take (fmt, buf, offset); a precompiled
+            # ``Struct`` method drops the fmt arg.
+            callee = dotted_name(call.func) or ""
+            struct_mod = callee.startswith("struct.")
+            fmt_idx = 0 if struct_mod else None
+            if terminal == "unpack":
+                off_idx = None
+            else:
+                off_idx = 2 if struct_mod else 1
+            hot = (
+                (fmt_idx is not None and fmt_idx in tainted_pos)
+                or (off_idx is not None and off_idx in tainted_pos)
+                or kw_slots.get("offset")
+            )
+            if hot:
+                self._report(
+                    call, "TPT001",
+                    f"tainted offset/count reaches 'struct.{terminal}' "
+                    "without a dominating range guard",
+                )
+            return
+        if not tainted_pos and not tainted_kw:
+            return
+        if terminal in _ALLOC_CALLS:
+            self._report(
+                call, "TPT001",
+                f"tainted size reaches allocation/read '{terminal}()' "
+                "without a dominating range guard",
+            )
+        elif terminal in _BLOCK_CALLS:
+            self._report(
+                call, "TPT002",
+                f"tainted value bounds blocking call '{terminal}()' "
+                "(a hostile peer controls how long this blocks)",
+            )
+        elif terminal == "range":
+            self._report(
+                call, "TPT002",
+                "tainted value bounds 'range()' without a dominating "
+                "range guard",
+            )
+        elif terminal == "setdefault" and isinstance(
+            call.func, ast.Attribute
+        ):
+            if arg_slots and arg_slots[0]:
+                recv = dotted_name(call.func.value) or ""
+                if self._is_known_dict(recv) and not self._dict_capped(recv):
+                    self._report(
+                        call, "TPT003",
+                        f"tainted key grows mapping '{recv or '<expr>'}' "
+                        "with no cardinality guard (cap entries or guard "
+                        "the key before insertion)",
+                    )
+
+    def _check_mult_alloc(self, expr: ast.BinOp) -> None:
+        if not isinstance(expr.op, ast.Mult):
+            return
+        for lit, size in ((expr.left, expr.right), (expr.right, expr.left)):
+            if (
+                isinstance(lit, (ast.Constant, ast.List, ast.Tuple))
+                and (not isinstance(lit, ast.Constant)
+                     or isinstance(lit.value, (str, bytes)))
+                and self._eval(size)
+            ):
+                self._report(
+                    expr, "TPT001",
+                    "tainted repeat count allocates 'literal * n' "
+                    "without a dominating range guard",
+                )
+                return
+
+    def _check_range_loop(self, iter_expr, ctx) -> None:
+        if (
+            isinstance(iter_expr, ast.Call)
+            and (dotted_name(iter_expr.func) or "").rsplit(".", 1)[-1]
+            == "range"
+        ):
+            return  # range() args already checked in _eval_call
+        return
+
+    # -- dict receivers -------------------------------------------------------
+
+    def _is_known_dict(self, recv: str) -> bool:
+        if not recv:
+            return False
+        if recv in self.dict_names:
+            return True
+        if recv.startswith("self.") and self.info.cls:
+            attrs = self.c._class_dict_attrs.get(
+                (self.mod.rel, self.info.cls), set()
+            )
+            return recv.split(".", 1)[1] in attrs
+        return False
+
+    def _dict_capped(self, recv: str) -> bool:
+        return recv in self.capped_dicts
+
+    # -- statements -----------------------------------------------------------
+
+    def _exec(self, stmt) -> None:
+        if stmt in self._nested:
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Import, ast.ImportFrom,
+                             ast.Global, ast.Nonlocal, ast.Pass)):
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._exec_assign(stmt)
+        elif isinstance(stmt, ast.Expr):
+            had = self._annotated(stmt)
+            slots = self._eval(stmt.value)
+            if had is not None and slots:
+                self._use_annotation(had)
+        elif isinstance(stmt, ast.Return):
+            slots = self._eval(stmt.value) if stmt.value else set()
+            if stmt.value is not None:
+                if isinstance(stmt.value, ast.Name):
+                    slots = self._slots(stmt.value.id)
+                if SELF_TAINT in slots:
+                    self.returns_taint = True
+                self.return_attrs |= slots - {SELF_TAINT}
+        elif isinstance(stmt, ast.If):
+            self._exec_if(stmt)
+        elif isinstance(stmt, ast.While):
+            self._exec_while(stmt)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._exec_for(stmt)
+        elif isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._exec(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._exec(s)
+            for s in stmt.orelse:
+                self._exec(s)
+            for s in stmt.finalbody:
+                self._exec(s)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._eval(item.context_expr)
+            for s in stmt.body:
+                self._exec(s)
+        elif isinstance(stmt, ast.Assert):
+            self._apply_guard(self._guard_names(stmt.test))
+            self._eval(stmt.test)
+        elif isinstance(stmt, ast.Raise):
+            if stmt.exc is not None:
+                self._eval(stmt.exc)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Subscript):
+                    self._eval_subscript(t, store=False)
+
+    def _exec_assign(self, stmt) -> None:
+        annotated = self._annotated(stmt)
+        value = stmt.value
+        slots = self._eval(value) if value is not None else set()
+        if isinstance(stmt, ast.AugAssign):
+            targets = [stmt.target]
+            # x += tainted keeps x's own taint too
+            tname = _target_name(stmt.target)
+            if tname:
+                slots |= self._slots(tname)
+        else:
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+        if annotated is not None:
+            if slots:
+                self._use_annotation(annotated)
+            slots = set()
+        for target in targets:
+            self._assign(target, slots, value)
+
+        # dict-literal locals are growth-trackable receivers
+        if (
+            not isinstance(stmt, ast.AugAssign)
+            and self.c._is_dict_expr(value)
+        ):
+            for target in targets:
+                name = _target_name(target)
+                if name:
+                    self.dict_names.add(name)
+
+    def _assign(self, target, slots: Set[str], value) -> None:
+        if isinstance(target, ast.Name):
+            self._set(target.id, slots)
+        elif isinstance(target, ast.Attribute):
+            dotted = dotted_name(target)
+            if dotted:
+                if slots:
+                    self.state[dotted] = set(slots)
+                    base = dotted.rsplit(".", 1)[0]
+                    self.state.setdefault(base, set()).add(target.attr)
+                else:
+                    self._clear(dotted)
+                if (
+                    isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                    and self.info.cls
+                ):
+                    cat = self.c._class_attr_taint.setdefault(
+                        (self.mod.rel, self.info.cls), {}
+                    )
+                    if slots:
+                        cur = cat.setdefault(target.attr, set())
+                        if not slots <= cur:
+                            cur |= slots
+                            self.c._changed = True
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                if isinstance(el, ast.Starred):
+                    el = el.value
+                self._assign(el, set(slots), value)
+        elif isinstance(target, ast.Subscript):
+            self._eval_subscript(target, store=True)
+            recv = dotted_name(target.value) or ""
+            if slots and recv:
+                self.state.setdefault(recv, set()).add(SELF_TAINT)
+
+    # -- control flow + guards ------------------------------------------------
+
+    def _guard_names(self, test) -> Set[str]:
+        """Names a raise/return-guarded comparison in ``test`` bounds:
+        each tainted name (or ``len(name)``) compared against at least
+        one untainted side."""
+        out: Set[str] = set()
+        for node in ast.walk(test):
+            if not isinstance(node, ast.Compare):
+                continue
+            sides = [node.left] + list(node.comparators)
+            names: Set[str] = set()
+            clean = False
+            for side in sides:
+                sn = self._side_names(side)
+                if sn:
+                    names |= sn
+                if _is_len_call(side) or (not sn and not self._eval(side)):
+                    # an untainted side bounds the others; so does
+                    # len(anything) — a buffer's measured length is a
+                    # host-trusted integer even when its bytes are not
+                    clean = True
+            if clean:
+                out |= names
+        return out
+
+    def _side_names(self, side) -> Set[str]:
+        """Tainted names referenced by one comparison side (unwrapping
+        ``len()``/arithmetic). Recursion stops at an Attribute chain (a
+        guard on ``req.kind`` bounds only that field, not all of
+        ``req``) and skips ``x % N`` / ``x & MASK`` clamp subtrees —
+        those sides are already host-bounded comparators."""
+        out: Set[str] = set()
+
+        def visit(node) -> None:
+            name = None
+            if isinstance(node, ast.Attribute):
+                name = dotted_name(node)
+            elif isinstance(node, ast.Name):
+                name = node.id
+            if name is not None:
+                if self._slots(name) or (
+                    isinstance(node, ast.Attribute)
+                    and self._eval_attribute(node)
+                ):
+                    out.add(name)
+                return
+            if (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, (ast.Mod, ast.BitAnd))
+                and not self._eval(node.right)
+            ):
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(side)
+        return out
+
+    @staticmethod
+    def _aborts(body: Sequence[ast.stmt]) -> bool:
+        return any(
+            isinstance(s, (ast.Raise, ast.Return, ast.Continue, ast.Break))
+            for s in body
+        )
+
+    def _apply_guard(self, names: Set[str]) -> None:
+        for name in names:
+            self._clear(name)
+
+    def _exec_if(self, stmt: ast.If) -> None:
+        guards = self._guard_names(stmt.test)
+        self._eval(stmt.test)
+        # len(d)-cap guards mark the dict as bounded for this function
+        for node in ast.walk(stmt.test):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "len"
+                and node.args
+            ):
+                recv = dotted_name(node.args[0])
+                if recv and self._is_known_dict(recv):
+                    self.capped_dicts.add(recv)
+        saved = {k: set(v) for k, v in self.state.items()}
+        self._apply_guard(guards)  # inside either branch the bound is known
+        for s in stmt.body:
+            self._exec(s)
+        body_state = self.state
+        self.state = {k: set(v) for k, v in saved.items()}
+        self._apply_guard(guards)
+        for s in stmt.orelse:
+            self._exec(s)
+        if self._aborts(stmt.body):
+            # the guarded names survive only bounded past this point
+            self._apply_guard(guards)
+            return
+        # merge: union of taint from both branches
+        for k, v in body_state.items():
+            self.state.setdefault(k, set()).update(v)
+        self._apply_guard(guards)
+
+    def _exec_while(self, stmt: ast.While) -> None:
+        tainted = self._side_names(stmt.test)
+        if tainted:
+            self._report(
+                stmt, "TPT002",
+                "tainted value bounds 'while' loop "
+                f"({', '.join(sorted(tainted))}) without a dominating "
+                "range guard",
+            )
+        self._eval(stmt.test)
+        for s in stmt.body:
+            self._exec(s)
+        for s in stmt.orelse:
+            self._exec(s)
+
+    def _exec_for(self, stmt) -> None:
+        it_slots = self._eval(stmt.iter)
+        elem = {SELF_TAINT} if SELF_TAINT in it_slots else set()
+        if (
+            isinstance(stmt.iter, ast.Call)
+            and isinstance(stmt.iter.func, ast.Name)
+            and stmt.iter.func.id in ("items",)
+        ):
+            pass
+        self._assign(stmt.target, elem, stmt.iter)
+        for s in stmt.body:
+            self._exec(s)
+        for s in stmt.orelse:
+            self._exec(s)
+        # per-element guard heuristic: a loop whose body raise-guards
+        # the loop variable bounds every element of the iterated names
+        target_names = _target_names(stmt.target)
+        if target_names and self._loop_guards_target(stmt, target_names):
+            for name in _ref_names(stmt.iter):
+                self._clear(name)
+        for name in target_names:
+            self.state.pop(name, None)
+
+    def _loop_guards_target(self, stmt, target_names: Set[str]) -> bool:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.If) and self._aborts(sub.body):
+                for node in ast.walk(sub.test):
+                    if isinstance(node, ast.Compare):
+                        for side in [node.left] + list(node.comparators):
+                            for n in ast.walk(side):
+                                if (
+                                    isinstance(n, ast.Name)
+                                    and n.id in target_names
+                                ):
+                                    return True
+        return False
+
+
+def _target_name(target) -> Optional[str]:
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return dotted_name(target)
+    return None
+
+
+def _is_len_call(node) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "len"
+    )
+
+
+def _ref_names(node) -> Set[str]:
+    """Names referenced by an expression, stopping at Attribute chains
+    (``req.pks`` contributes "req.pks", never bare "req")."""
+    out: Set[str] = set()
+
+    def visit(n) -> None:
+        if isinstance(n, ast.Attribute):
+            dotted = dotted_name(n)
+            if dotted:
+                out.add(dotted)
+                return
+        elif isinstance(n, ast.Name):
+            out.add(n.id)
+            return
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(node)
+    return out
+
+
+def _target_names(target) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            out |= _target_names(el)
+    else:
+        n = _target_name(target)
+        if n:
+            out.add(n)
+    return out

@@ -18,6 +18,29 @@ echo "== analysis (tpulint) =="
 # baseline) fail the gate.
 python -m scripts.analysis || rc_total=1
 
+echo "== tpuflow: taint analysis + deterministic wire fuzz =="
+# The TPT family rides the tpulint run above against the committed
+# baseline; this stage additionally requires the taint family to be
+# clean WITHOUT the baseline — no TPT finding is ever grandfathered,
+# every wire-tainted bound must carry a real guard (or an audited
+# `# tpuflow: sanitized=` annotation).
+python -m scripts.analysis --no-baseline --enable taint || {
+    echo "tpuflow: unbaselined TPT findings (see above)" >&2
+    rc_total=1
+}
+# The runtime half: 10 fixed seeds of structured mutations over the
+# checked-in corpus, all four decode surfaces. Any hang, uncaught
+# struct.error/IndexError/MemoryError, or silent wrong decode fails
+# the stage; the failing seed replays byte-identically.
+for seed in 0 1 2 3 4 5 6 7 8 9; do
+    timeout -k 10 60 env JAX_PLATFORMS=cpu \
+        python tests/fuzz_wire.py --seed $seed --smoke || {
+        echo "tpuflow fuzz: FAILED under seed $seed — replay with" \
+             "python tests/fuzz_wire.py --seed $seed" >&2
+        rc_total=1
+    }
+done
+
 echo "== sanitizer-enabled concurrency tests =="
 # the lock-order sanitizer records the acquisition-order graph while
 # the concurrency-heavy modules run their tests; an AB/BA inversion

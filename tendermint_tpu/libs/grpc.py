@@ -203,6 +203,10 @@ class HpackDecoder:
             self._size -= len(n) + len(v) + 32
 
     def _string(self, data: bytes, pos: int) -> Tuple[str, int]:
+        if pos >= len(data):
+            # a block that ends right where a string should begin is a
+            # protocol error, not an IndexError
+            raise H2ProtocolError("truncated HPACK string")
         huffman = bool(data[pos] & 0x80)
         length, pos = _decode_int(data, pos, 7)
         if pos + length > len(data):
@@ -260,6 +264,14 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
 def read_frame(sock: socket.socket) -> Tuple[int, int, int, bytes]:
     hdr = _read_exact(sock, 9)
     length = int.from_bytes(hdr[:3], "big")
+    # we advertise SETTINGS_MAX_FRAME_SIZE=MAX_FRAME, so a larger frame
+    # is a protocol violation — reject it before allocating up to 16MB-1
+    # of peer-controlled buffer (RFC 9113 4.2 FRAME_SIZE_ERROR)
+    if length > MAX_FRAME:
+        raise H2ProtocolError(
+            f"frame length {length} exceeds SETTINGS_MAX_FRAME_SIZE "
+            f"{MAX_FRAME}"
+        )
     ftype, flags = hdr[3], hdr[4]
     stream_id = int.from_bytes(hdr[5:9], "big") & 0x7FFFFFFF
     payload = _read_exact(sock, length) if length else b""
@@ -710,6 +722,13 @@ class _H2ServerConn:
                 if len(self._buf) < 9:
                     return
                 length = int.from_bytes(self._buf[:3], "big")
+                # same FRAME_SIZE_ERROR bound as read_frame: don't sit
+                # buffering up to 16MB-1 for a frame we will never accept
+                if length > MAX_FRAME:
+                    raise H2ProtocolError(
+                        f"frame length {length} exceeds "
+                        f"SETTINGS_MAX_FRAME_SIZE {MAX_FRAME}"
+                    )
                 if len(self._buf) < 9 + length:
                     return
                 ftype, flags = self._buf[3], self._buf[4]

@@ -284,6 +284,11 @@ class RPCServer:
 
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
+                # same bound the evloop transport enforces: a declared
+                # Content-Length is peer data, not an allocation size
+                if length > _MAX_BODY_BYTES:
+                    self._send(413, b'{"error": "request body too large"}')
+                    return
                 body = self.rfile.read(length) if length else b""
                 self._send(200, server._post_body(body))
 

@@ -587,6 +587,9 @@ class VerifydClient:
             self.metrics.e2e_stage_seconds.labels(stage=stage).observe(
                 v, exemplar=exem
             )
+            # tpuflow: sanitized=keys come from zip(STAGE_NAMES, ...) in
+            # unpack_stages — a host constant list, so cardinality is
+            # bounded even though the stage VALUES are wire data
             self.stage_totals[stage] = self.stage_totals.get(stage, 0.0) + v
         overhead = max(0.0, wall_s - attributed)
         self.metrics.e2e_stage_seconds.labels(stage="transport").observe(

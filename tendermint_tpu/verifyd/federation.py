@@ -43,6 +43,7 @@ spans the fleet instead of resetting per shard.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import random
 import threading
@@ -82,6 +83,13 @@ DEFAULT_FAILOVER_BACKOFF_S = 0.02
 # distinct keys than this rebuilds from scratch (committees rotate;
 # unbounded growth would be a leak, stale entries only cost locality)
 _OWNER_INDEX_CAP = 16384
+
+# gossip snapshot bounds: a misbehaving shard's STATS reply must not be
+# able to balloon every peer's fleet view. Oversized snapshots are
+# dropped whole (and counted in gossip_rejects) rather than truncated —
+# a partial health view is worse than a missing one.
+MAX_GOSSIP_TENANTS = 1024  # tenant entries per snapshot
+MAX_GOSSIP_SNAPSHOT_BYTES = 256 * 1024  # JSON-encoded snapshot size
 
 
 def _hash64(data: bytes) -> int:
@@ -212,6 +220,7 @@ class FederationClient:
         self.failovers = 0  # guarded-by: _mtx
         self.rerouted_lanes = 0  # guarded-by: _mtx
         self.host_fallback_lanes = 0  # guarded-by: _mtx
+        self.gossip_rejects = 0  # guarded-by: _mtx
         self._push_epoch(self.route_epoch)
 
     # --- membership ---------------------------------------------------------
@@ -427,14 +436,44 @@ class FederationClient:
         snaps: Dict[int, dict] = {}
         for sid, client in enumerate(self._clients):
             try:
-                snaps[sid] = client.server_stats(timeout=timeout)
+                snap = client.server_stats(timeout=timeout)
             except VerifydUnavailableError:
                 self._mark_dead(sid)
-            else:
-                self._mark_alive(sid)
+                continue
+            # the shard answered, so it is alive either way; but an
+            # oversized snapshot is dropped before it can reach the
+            # merged fleet view
+            self._mark_alive(sid)
+            try:
+                # tpuflow: sanitized=_sanitize_snapshot raises on
+                # snapshots over MAX_GOSSIP_TENANTS entries or
+                # MAX_GOSSIP_SNAPSHOT_BYTES encoded bytes
+                snaps[sid] = self._sanitize_snapshot(snap)
+            except ValueError:
+                with self._mtx:
+                    self.gossip_rejects += 1
         with self._mtx:
             self._gossip = dict(snaps)
         return snaps
+
+    @staticmethod
+    def _sanitize_snapshot(snap: dict) -> dict:
+        """Bound one shard's gossip snapshot before it joins the fleet
+        view; raises ValueError when any cap is exceeded."""
+        if not isinstance(snap, dict):
+            raise ValueError("gossip snapshot is not a dict")
+        tenants = snap.get("tenants")
+        if isinstance(tenants, dict) and len(tenants) > MAX_GOSSIP_TENANTS:
+            raise ValueError(
+                f"gossip snapshot lists {len(tenants)} tenants "
+                f"> {MAX_GOSSIP_TENANTS}"
+            )
+        encoded = len(json.dumps(snap, default=str))
+        if encoded > MAX_GOSSIP_SNAPSHOT_BYTES:
+            raise ValueError(
+                f"gossip snapshot {encoded}B > {MAX_GOSSIP_SNAPSHOT_BYTES}B"
+            )
+        return snap
 
     def fleet_tenants(self) -> Dict[str, Dict[str, float]]:
         """Merge the last refresh()'s per-shard tenant views into ONE
@@ -489,6 +528,7 @@ class FederationClient:
                 "failovers": self.failovers,
                 "rerouted_lanes": self.rerouted_lanes,
                 "host_fallback_lanes": self.host_fallback_lanes,
+                "gossip_rejects": self.gossip_rejects,
                 "owner_index_keys": len(self._owner),
             }
         per_shard = []

@@ -150,6 +150,12 @@ MAX_TRACE_LEN = 64  # wire-level cap; today's context is 17 bytes
 # declare an absurd target that skews the server's budget arithmetic.
 MAX_SLO_MS = 600_000  # 10 minutes — far beyond any real latency SLO
 
+# request deadline (field 3): 0 = no deadline (server default applies).
+# Capped like slo_ms — the server turns this straight into blocking
+# waits (`entry.done.wait(timeout=...)`), so an uncapped 64-bit varint
+# would let one request pin a stream worker for centuries.
+MAX_DEADLINE_MS = 600_000  # same 10-minute ceiling as MAX_SLO_MS
+
 # federation routing (fields 9/10): shard ids are small ordinals into
 # the operator's --shards list; the epoch is a monotone counter bumped
 # on membership change. Both capped so a hostile client can't make the
@@ -316,10 +322,14 @@ def decode_request(data: bytes) -> VerifyRequest:
                         sig = lane.read_bytes()
                     else:
                         lane.skip(lwire)
-                if pk is None or msg is None or sig is None:
-                    raise ValueError("lane missing pk/msg/sig")
+                if pk is None or sig is None:
+                    raise ValueError("lane missing pk/sig")
                 req.pks.append(pk)
-                req.msgs.append(msg)
+                # proto3 zero-omission: an absent msg and an explicitly
+                # empty one are the same lane (signing empty messages is
+                # legal), so both decode to b"" — otherwise an empty msg
+                # round-trips into a frame the decoder rejects
+                req.msgs.append(msg or b"")
                 req.sigs.append(sig)
             elif fld == 6 and wire == WIRE_BYTES:
                 req.tenant = r.read_bytes().decode("utf-8", "replace")
@@ -350,6 +360,8 @@ def decode_request(data: bytes) -> VerifyRequest:
     req.slo_ms = req.slo_ms or 0
     # absence (unfederated client) means no routing epoch (TPW004)
     req.route_epoch = req.route_epoch or 0
+    if req.deadline_ms > MAX_DEADLINE_MS:
+        raise ValueError(f"deadline_ms too large: {req.deadline_ms}")
     if req.slo_ms > MAX_SLO_MS:
         raise ValueError(f"slo_ms too large: {req.slo_ms}")
     if req.shard_id > MAX_SHARD_ID:

@@ -245,6 +245,13 @@ def unpack_header(buf, base: int) -> dict:
     """Read and validate a slab header; raises ValueError on a torn or
     malformed slab (mirrors ``protocol.decode_request`` so the server
     answers STATUS_INVALID instead of crashing a drain worker)."""
+    if len(buf) - base < SLAB_HEADER_BYTES:
+        # a short buffer must be the same typed error a torn slab is,
+        # not a struct.error out of whichever field read hits the end
+        raise ValueError(
+            f"slab header truncated: {len(buf) - base}B < "
+            f"{SLAB_HEADER_BYTES}B"
+        )
     (gen,) = struct.unpack_from("<I", buf, base + SLAB_OFF_GEN)
     (kind,) = struct.unpack_from("<I", buf, base + SLAB_OFF_KIND)
     (klass_raw,) = struct.unpack_from("<I", buf, base + SLAB_OFF_KLASS)
@@ -274,6 +281,8 @@ def unpack_header(buf, base: int) -> dict:
         raise ValueError(f"too many lanes: {lanes} > {SHM_MAX_LANES}")
     if tenant_len > MAX_TENANT_LEN:
         raise ValueError(f"tenant name too long: {tenant_len}")
+    if deadline_ms > protocol.MAX_DEADLINE_MS:
+        raise ValueError(f"deadline_ms too large: {deadline_ms}")
     if slo_ms > protocol.MAX_SLO_MS:
         raise ValueError(f"slo_ms too large: {slo_ms}")
     if shard_raw > protocol.MAX_SHARD_ID + 1:
@@ -903,10 +912,17 @@ class _ShmServerProtocol:
             off = 0
             (tlen,) = struct.unpack_from("<H", body, off)
             off += 2
+            # explicit bounds beat the silent slice-truncation Python
+            # would give us: a short frame must be a typed ATTACH_ERR,
+            # not a token that mysteriously fails to compare
+            if off + tlen > len(body):
+                raise ValueError("truncated ATTACH frame (token)")
             token = body[off : off + tlen].decode("utf-8")
             off += tlen
             (nlen,) = struct.unpack_from("<H", body, off)
             off += 2
+            if off + nlen > len(body):
+                raise ValueError("truncated ATTACH frame (segment name)")
             name = body[off : off + nlen].decode("utf-8")
             off += nlen
             nslabs, slab_bytes = struct.unpack_from("<II", body, off)
@@ -1121,6 +1137,10 @@ class ShmClientTransport:
             )
             _send_frame(sock, MSG_ATTACH, body)
             length, typ = _FRAME_HDR.unpack(_recv_exact(sock, _FRAME_HDR.size))
+            # the peer's declared reply length is untrusted; ATTACH_OK
+            # has no body and ATTACH_ERR is truncated to 512B serverside
+            if length > _MAX_FRAME:
+                raise ShmAttachError(f"attach reply too large: {length}")
             reply = _recv_exact(sock, length) if length else b""
             if typ != MSG_ATTACH_OK:
                 raise ShmAttachError(
@@ -1266,11 +1286,20 @@ class ShmClientTransport:
                 length, typ = _FRAME_HDR.unpack(
                     _recv_exact(sock, _FRAME_HDR.size)
                 )
+                # same bound the server's _FrameBuf.feed enforces: a
+                # rogue doorbell peer must not pick our allocation size
+                if length > _MAX_FRAME:
+                    raise ShmError(f"doorbell frame too large: {length}")
                 body = _recv_exact(sock, length) if length else b""
                 if typ == MSG_RESP:
                     (
                         seq, _slot, status, _held, depth, mlen, slen,
                     ) = _RESP_HEAD.unpack_from(body, 0)
+                    # declared lengths must fit the frame we actually
+                    # got — slicing past the end would silently decode
+                    # a truncated stage vector / message as valid
+                    if _RESP_HEAD.size + slen + mlen > len(body):
+                        raise ShmError("truncated doorbell RESP frame")
                     off = _RESP_HEAD.size
                     stages = bytes(body[off : off + slen])
                     off += slen

@@ -1,0 +1,625 @@
+"""Deterministic structured wire fuzzer — the runtime half of tpuflow.
+
+The TPT taint checker (``scripts/analysis/taint.py``) proves statically
+that every wire-derived length/bound is guarded before it reaches a
+sink; this harness proves the same property dynamically. A seeded
+mutator (bit flips, varint boundary values, truncations, length-field
+inflation, duplicate/unknown fields) runs over a checked-in corpus of
+valid frames for all four decode surfaces:
+
+- **protocol** — ``decode_request`` / ``decode_response`` (TCP framing)
+- **shm**      — ``unpack_header`` (doorbell slab headers)
+- **grpc**     — ``grpc_unframe`` / ``HpackDecoder.decode`` /
+  ``_strip_padding`` (the pure HTTP/2 parsers)
+- **rpc**      — ``RPCServer._post_body`` (JSON-RPC envelope)
+
+Every mutated frame must yield a clean *typed* error (the surface's
+declared exception) or a correct decode — never a hang, never an
+uncaught ``struct.error``/``IndexError``/``MemoryError``, and never a
+silently-accepted wrong decode: any accepted frame is re-encoded and
+re-decoded, and the two decodes must agree (canonical round-trip).
+
+Everything is derived from ``random.Random(seed)``, so a failing seed
+replays byte-identically:
+
+    python tests/fuzz_wire.py --seed 7
+    python tests/fuzz_wire.py --seed 7 --surface grpc --verbose
+
+The corpus lives in ``tests/fuzz_corpus/`` and is checked in;
+``--regen`` rewrites it from the builders below (the pytest corpus
+tests fail if the two drift apart).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import sys
+import time
+from typing import Callable, Dict, List, Tuple
+
+if __name__ == "__main__":  # CLI use: make the repo importable
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.libs import grpc as grpclib
+from tendermint_tpu.libs.grpc import (
+    FLAG_PADDED,
+    GrpcError,
+    H2ProtocolError,
+    HpackDecoder,
+    grpc_frame,
+    grpc_unframe,
+    hpack_encode,
+)
+from tendermint_tpu.verifyd import protocol, shm
+
+CORPUS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fuzz_corpus")
+
+# exceptions that are NEVER acceptable, no matter how they surface —
+# the exact classes the taint checker's sinks exist to prevent
+_FORBIDDEN = (MemoryError, RecursionError, SystemError)
+
+# soft hang detector: any single decode this slow on a <=4 KiB frame
+# means an attacker-controlled bound made it into a loop
+_HANG_BUDGET_S = 5.0
+
+_VARINT_BOUNDARIES = (
+    0, 1, 127, 128, 2**31 - 1, 2**31, 2**63 - 1, 2**63, 2**64 - 1
+)
+
+
+class FuzzFailure(AssertionError):
+    """One mutated frame violated the harness contract. Carries enough
+    context to replay: seed, surface, parser, corpus index, frame hex."""
+
+    def __init__(self, message: str, *, seed: int, parser: str,
+                 index: int, frame: bytes):
+        super().__init__(
+            f"{message}\n  replay: python tests/fuzz_wire.py --seed {seed}"
+            f"\n  parser={parser} corpus_index={index}"
+            f"\n  frame={frame[:256].hex()}{'...' if len(frame) > 256 else ''}"
+        )
+        self.seed = seed
+        self.parser = parser
+        self.index = index
+        self.frame = frame
+
+
+def _encode_varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+# --- corpus builders ---------------------------------------------------------
+#
+# One function per parser, returning the valid frames mutations start
+# from. Checked-in copies live in tests/fuzz_corpus/<parser>.<i>.bin;
+# test_corpus_matches_builders keeps them in sync.
+
+
+def _corpus_request() -> List[bytes]:
+    lane = lambda i: (  # noqa: E731 - local shorthand
+        bytes([i]) * protocol.PUBKEY_SIZE,
+        b"msg-%d" % i,
+        bytes([0x80 | i]) * protocol.SIG_SIZE,
+    )
+    minimal = protocol.VerifyRequest()
+    one = protocol.VerifyRequest(
+        pks=[lane(1)[0]], msgs=[lane(1)[1]], sigs=[lane(1)[2]]
+    )
+    full = protocol.VerifyRequest(
+        kind=protocol.KIND_COMMIT,
+        klass=protocol.CLASS_CONSENSUS,
+        deadline_ms=1500,
+        algo=protocol.ALGO_SR25519,
+        pks=[lane(i)[0] for i in range(3)],
+        msgs=[lane(i)[1] for i in range(3)],
+        sigs=[lane(i)[2] for i in range(3)],
+        tenant="fuzz-tenant",
+        trace=b"\x01" * 17,
+        slo_ms=250,
+        shard_id=7,
+        route_epoch=42,
+    )
+    return [protocol.encode_request(r) for r in (minimal, one, full)]
+
+
+def _corpus_response() -> List[bytes]:
+    ok = protocol.VerifyResponse(verdicts=[True, False, True])
+    err = protocol.VerifyResponse(
+        status=protocol.STATUS_RESOURCE_EXHAUSTED,
+        message="shed: queue full",
+        queue_depth=17,
+        shard_id=3,
+    )
+    staged = protocol.VerifyResponse(
+        verdicts=[True],
+        stages=protocol.pack_stages(
+            {name: 0.25 for name in protocol.STAGE_NAMES}
+        ),
+    )
+    return [protocol.encode_response(r) for r in (ok, err, staged)]
+
+
+def _corpus_slab_header() -> List[bytes]:
+    frames = []
+    for kwargs in (
+        dict(gen=2, kind=protocol.KIND_RAW, klass=protocol.CLASS_RPC,
+             deadline_ms=0, algo=protocol.ALGO_ED25519, lanes=1),
+        dict(gen=44, kind=protocol.KIND_COMMIT,
+             klass=protocol.CLASS_CONSENSUS, deadline_ms=900,
+             algo=protocol.ALGO_SR25519, lanes=64, tenant="fuzz-tenant",
+             trace=b"\x02" * 17, slo_ms=100, shard_id=2, route_epoch=9),
+    ):
+        buf = bytearray(shm.SLAB_HEADER_BYTES)
+        shm.pack_header(buf, 0, **kwargs)
+        frames.append(bytes(buf))
+    return frames
+
+
+def _corpus_grpc_message() -> List[bytes]:
+    return [
+        grpc_frame(b""),
+        grpc_frame(b"verify-payload"),
+        grpc_frame(b"\x00" * 64),
+    ]
+
+
+def _corpus_hpack_block() -> List[bytes]:
+    return [
+        hpack_encode([(":method", "POST"), (":path", "/verifyd.Verify")]),
+        hpack_encode([
+            (":status", "200"),
+            ("content-type", "application/grpc"),
+            ("grpc-status", "0"),
+        ]),
+    ]
+
+
+def _corpus_padded_frame() -> List[bytes]:
+    # _strip_padding input: Pad Length byte + data + padding
+    return [
+        bytes([4]) + b"payload" + b"\x00" * 4,
+        bytes([0]) + b"no-padding",
+    ]
+
+
+def _corpus_jsonrpc() -> List[bytes]:
+    single = {"jsonrpc": "2.0", "id": 1, "method": "echo",
+              "params": {"x": 1}}
+    batch = [
+        {"jsonrpc": "2.0", "id": 2, "method": "echo", "params": {}},
+        {"jsonrpc": "2.0", "id": 3, "method": "missing", "params": {}},
+    ]
+    notification = {"jsonrpc": "2.0", "method": "echo", "params": {}}
+    return [json.dumps(v).encode() for v in (single, batch, notification)]
+
+
+_CORPUS_BUILDERS: Dict[str, Callable[[], List[bytes]]] = {
+    "request": _corpus_request,
+    "response": _corpus_response,
+    "slab_header": _corpus_slab_header,
+    "grpc_message": _corpus_grpc_message,
+    "hpack_block": _corpus_hpack_block,
+    "padded_frame": _corpus_padded_frame,
+    "jsonrpc": _corpus_jsonrpc,
+}
+
+SURFACES: Dict[str, Tuple[str, ...]] = {
+    "protocol": ("request", "response"),
+    "shm": ("slab_header",),
+    "grpc": ("grpc_message", "hpack_block", "padded_frame"),
+    "rpc": ("jsonrpc",),
+}
+
+
+def corpus_files() -> List[Tuple[str, bytes]]:
+    """(relative filename, frame bytes) for the whole checked-in corpus."""
+    out = []
+    for parser, builder in sorted(_CORPUS_BUILDERS.items()):
+        for i, frame in enumerate(builder()):
+            out.append((f"{parser}.{i}.bin", frame))
+    return out
+
+
+def load_corpus(parser: str) -> List[bytes]:
+    """The checked-in frames for one parser, falling back to the
+    builders when the corpus directory is absent (fresh checkout)."""
+    frames = []
+    if os.path.isdir(CORPUS_DIR):
+        for name in sorted(os.listdir(CORPUS_DIR)):
+            if name.startswith(parser + ".") and name.endswith(".bin"):
+                with open(os.path.join(CORPUS_DIR, name), "rb") as fh:
+                    frames.append(fh.read())
+    return frames or _CORPUS_BUILDERS[parser]()
+
+
+# --- structured mutator ------------------------------------------------------
+
+
+class Mutator:
+    """Seeded structured mutations; every choice flows from one
+    ``random.Random(seed)`` so a seed fully determines the run."""
+
+    def __init__(self, rng: random.Random):
+        self.rng = rng
+        self._ops = (
+            self._bit_flip,
+            self._byte_set,
+            self._truncate,
+            self._extend,
+            self._varint_boundary,
+            self._inflate_len32,
+            self._duplicate_slice,
+            self._unknown_field,
+        )
+
+    def mutate(self, frame: bytes) -> bytes:
+        data = bytearray(frame)
+        for _ in range(self.rng.randint(1, 3)):
+            data = self.rng.choice(self._ops)(data)
+        return bytes(data)
+
+    def _bit_flip(self, data: bytearray) -> bytearray:
+        if data:
+            for _ in range(self.rng.randint(1, 8)):
+                i = self.rng.randrange(len(data))
+                data[i] ^= 1 << self.rng.randrange(8)
+        return data
+
+    def _byte_set(self, data: bytearray) -> bytearray:
+        if data:
+            i = self.rng.randrange(len(data))
+            data[i] = self.rng.randrange(256)
+        return data
+
+    def _truncate(self, data: bytearray) -> bytearray:
+        if data:
+            del data[self.rng.randrange(len(data)):]
+        return data
+
+    def _extend(self, data: bytearray) -> bytearray:
+        data += bytes(
+            self.rng.randrange(256)
+            for _ in range(self.rng.randint(1, 16))
+        )
+        return data
+
+    def _varint_boundary(self, data: bytearray) -> bytearray:
+        """Splice an encoded varint boundary value (0, 1, 2^31, 2^63,
+        2^64-1, ...) over a random window — the length-field abuse the
+        TPT001/TPT002 sinks exist for."""
+        enc = _encode_varint(self.rng.choice(_VARINT_BOUNDARIES))
+        pos = self.rng.randrange(len(data) + 1)
+        data[pos:pos + len(enc)] = enc
+        return data
+
+    def _inflate_len32(self, data: bytearray) -> bytearray:
+        """Overwrite a 4-byte window with a huge big-endian length —
+        targets the fixed-width length prefixes (gRPC framing, slab
+        u32 fields)."""
+        if len(data) >= 4:
+            pos = self.rng.randrange(len(data) - 3)
+            data[pos:pos + 4] = self.rng.choice(
+                (0xFFFFFFFF, 0x7FFFFFFF, 1 << 20, (1 << 20) + 1)
+            ).to_bytes(4, "big")
+        return data
+
+    def _duplicate_slice(self, data: bytearray) -> bytearray:
+        if data:
+            a = self.rng.randrange(len(data))
+            b = self.rng.randrange(a, min(len(data), a + 64) + 1)
+            data[a:a] = data[a:b]
+        return data
+
+    def _unknown_field(self, data: bytearray) -> bytearray:
+        """Append a well-formed proto field with an unassigned number —
+        decoders must skip it, not choke."""
+        fld = self.rng.randint(11, 30)
+        if self.rng.random() < 0.5:
+            data += _encode_varint(fld << 3) + _encode_varint(
+                self.rng.choice(_VARINT_BOUNDARIES)
+            )
+        else:
+            payload = bytes(self.rng.randrange(256)
+                            for _ in range(self.rng.randint(0, 8)))
+            data += _encode_varint((fld << 3) | 2)
+            data += _encode_varint(len(payload)) + payload
+        return data
+
+
+# --- per-parser drivers ------------------------------------------------------
+#
+# Each driver: mutated frame -> outcome string. Typed rejections come
+# back as "err:<Class>"; accepted frames are round-tripped and come
+# back as "ok:<sha256 of the canonical re-encode>". Anything else
+# raises FuzzViolation (wrapped into FuzzFailure by the runner).
+
+
+class FuzzViolation(Exception):
+    pass
+
+
+def _drive_request(data: bytes) -> str:
+    try:
+        req = protocol.decode_request(data)
+    except ValueError as exc:
+        return f"err:ValueError:{type(exc.__cause__).__name__}"
+    canon = protocol.encode_request(req)
+    if protocol.decode_request(canon) != req:
+        raise FuzzViolation("request round-trip mismatch (silent wrong decode)")
+    return "ok:" + hashlib.sha256(canon).hexdigest()
+
+
+def _drive_response(data: bytes) -> str:
+    try:
+        resp = protocol.decode_response(data)
+    except ValueError as exc:
+        return f"err:ValueError:{type(exc.__cause__).__name__}"
+    canon = protocol.encode_response(resp)
+    if protocol.decode_response(canon) != resp:
+        raise FuzzViolation("response round-trip mismatch (silent wrong decode)")
+    return "ok:" + hashlib.sha256(canon).hexdigest()
+
+
+def _drive_slab_header(data: bytes) -> str:
+    try:
+        hdr = shm.unpack_header(bytearray(data), 0)
+    except ValueError:
+        return "err:ValueError"
+    if len(hdr["tenant"].encode("utf-8")) > protocol.MAX_TENANT_LEN:
+        # hostile tenant bytes decode via 'replace' into a string whose
+        # re-encoding outgrows the fixed slab field; the decode itself
+        # was faithful, it just has no canonical re-encoding
+        return "ok:unencodable:" + hashlib.sha256(
+            repr(hdr).encode()
+        ).hexdigest()
+    buf = bytearray(shm.SLAB_HEADER_BYTES)
+    shm.pack_header(buf, 0, **hdr)
+    if shm.unpack_header(buf, 0) != hdr:
+        raise FuzzViolation("slab header round-trip mismatch")
+    return "ok:" + hashlib.sha256(bytes(buf)).hexdigest()
+
+
+def _drive_grpc_message(data: bytes) -> str:
+    try:
+        payload = grpc_unframe(data)
+    except GrpcError:
+        return "err:GrpcError"
+    if grpc_unframe(grpc_frame(payload)) != payload:
+        raise FuzzViolation("gRPC message round-trip mismatch")
+    return "ok:" + hashlib.sha256(payload).hexdigest()
+
+
+def _drive_hpack_block(data: bytes) -> str:
+    try:
+        headers = HpackDecoder().decode(data)
+    except H2ProtocolError:
+        return "err:H2ProtocolError"
+    try:
+        canon = hpack_encode(headers)
+    except UnicodeEncodeError:
+        # surrogateescape preserved undecodable bytes faithfully; the
+        # decode was correct, it just has no clean re-encoding
+        return "ok:unencodable:" + hashlib.sha256(
+            repr(headers).encode("utf-8", "surrogateescape")
+        ).hexdigest()
+    if HpackDecoder().decode(canon) != headers:
+        raise FuzzViolation("HPACK round-trip mismatch")
+    return "ok:" + hashlib.sha256(canon).hexdigest()
+
+
+def _drive_padded_frame(data: bytes) -> str:
+    try:
+        payload = grpclib._strip_padding(FLAG_PADDED, data)
+    except H2ProtocolError:
+        return "err:H2ProtocolError"
+    # re-wrap with the padding the parser said it stripped
+    pad = data[0]
+    canon = bytes([pad]) + payload + b"\x00" * pad
+    if grpclib._strip_padding(FLAG_PADDED, canon) != payload:
+        raise FuzzViolation("padding round-trip mismatch")
+    return "ok:" + hashlib.sha256(payload).hexdigest()
+
+
+_RPC_SERVER = None
+
+
+def _rpc_server():
+    global _RPC_SERVER
+    if _RPC_SERVER is None:
+        from tendermint_tpu.rpc.server import RPCServer
+
+        _RPC_SERVER = RPCServer(
+            {"echo": lambda **params: params}, evloop=False
+        )
+    return _RPC_SERVER
+
+
+def _drive_jsonrpc(data: bytes) -> str:
+    # _post_body must never raise: every malformed body becomes a
+    # JSON-RPC error envelope
+    out = _rpc_server()._post_body(data)
+    try:
+        env = json.loads(out)
+    except ValueError as exc:
+        raise FuzzViolation(f"non-JSON RPC response: {exc}") from exc
+    for item in env if isinstance(env, list) else [env]:
+        if not isinstance(item, dict) or item.get("jsonrpc") != "2.0":
+            raise FuzzViolation(f"malformed RPC envelope: {item!r}")
+        if "result" not in item and "error" not in item:
+            raise FuzzViolation(f"RPC envelope lacks result/error: {item!r}")
+    return "ok:" + hashlib.sha256(out).hexdigest()
+
+
+_DRIVERS: Dict[str, Callable[[bytes], str]] = {
+    "request": _drive_request,
+    "response": _drive_response,
+    "slab_header": _drive_slab_header,
+    "grpc_message": _drive_grpc_message,
+    "hpack_block": _drive_hpack_block,
+    "padded_frame": _drive_padded_frame,
+    "jsonrpc": _drive_jsonrpc,
+}
+
+
+# --- runner ------------------------------------------------------------------
+
+
+def fuzz_parser(parser: str, seed: int, iterations: int) -> List[str]:
+    """Fuzz one parser; returns the per-case outcome log (used for the
+    byte-identical replay check). Raises FuzzFailure on any violation."""
+    rng = random.Random(f"{parser}:{seed}")
+    mut = Mutator(rng)
+    drive = _DRIVERS[parser]
+    corpus = load_corpus(parser)
+    log = []
+    for i, frame in enumerate(corpus):
+        # the pristine frame must always be accepted
+        base = drive(frame)
+        if not base.startswith("ok:"):
+            raise FuzzFailure(
+                f"corpus frame rejected: {base}",
+                seed=seed, parser=parser, index=i, frame=frame,
+            )
+        log.append(f"{parser}.{i}.base {base}")
+        for case in range(iterations):
+            frame_m = mut.mutate(frame)
+            start = time.monotonic()
+            try:
+                outcome = drive(frame_m)
+            except FuzzViolation as exc:
+                raise FuzzFailure(
+                    str(exc), seed=seed, parser=parser, index=i,
+                    frame=frame_m,
+                ) from exc
+            except _FORBIDDEN as exc:
+                raise FuzzFailure(
+                    f"forbidden {type(exc).__name__}: {exc}",
+                    seed=seed, parser=parser, index=i, frame=frame_m,
+                ) from exc
+            except Exception as exc:
+                raise FuzzFailure(
+                    f"uncaught {type(exc).__name__}: {exc}",
+                    seed=seed, parser=parser, index=i, frame=frame_m,
+                ) from exc
+            elapsed = time.monotonic() - start
+            if elapsed > _HANG_BUDGET_S:
+                raise FuzzFailure(
+                    f"hang: one decode took {elapsed:.1f}s",
+                    seed=seed, parser=parser, index=i, frame=frame_m,
+                )
+            log.append(f"{parser}.{i}.{case} {outcome}")
+    return log
+
+
+def fuzz_run(seed: int, iterations: int, surfaces=None) -> Tuple[str, int]:
+    """Fuzz every parser of the requested surfaces. Returns (sha256
+    digest of the full outcome log, number of cases)."""
+    names = surfaces or sorted(SURFACES)
+    log: List[str] = []
+    for surface in names:
+        for parser in SURFACES[surface]:
+            log.extend(fuzz_parser(parser, seed, iterations))
+    blob = "\n".join(log).encode()
+    return hashlib.sha256(blob).hexdigest(), len(log)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--iters", type=int, default=300,
+                    help="mutations per corpus frame (default 300)")
+    ap.add_argument("--surface", choices=sorted(SURFACES), default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI smoke: fewer iterations per frame")
+    ap.add_argument("--regen", action="store_true",
+                    help="rewrite tests/fuzz_corpus/ from the builders")
+    args = ap.parse_args(argv)
+
+    if args.regen:
+        os.makedirs(CORPUS_DIR, exist_ok=True)
+        for name, frame in corpus_files():
+            with open(os.path.join(CORPUS_DIR, name), "wb") as fh:
+                fh.write(frame)
+            print(f"wrote fuzz_corpus/{name} ({len(frame)}B)")
+        return 0
+
+    iters = 60 if args.smoke else args.iters
+    surfaces = [args.surface] if args.surface else None
+    try:
+        digest, cases = fuzz_run(args.seed, iters, surfaces)
+    except FuzzFailure as exc:
+        print(f"FUZZ FAILURE (seed={args.seed}):\n{exc}", file=sys.stderr)
+        return 1
+    print(f"fuzz_wire: seed={args.seed} cases={cases} digest={digest}")
+    return 0
+
+
+# --- pytest integration ------------------------------------------------------
+
+
+def test_corpus_matches_builders():
+    """The checked-in corpus must equal what the builders produce —
+    corpus drift would silently shrink fuzz coverage."""
+    for name, frame in corpus_files():
+        path = os.path.join(CORPUS_DIR, name)
+        assert os.path.exists(path), (
+            f"missing corpus file {name}; run "
+            "`python tests/fuzz_wire.py --regen`"
+        )
+        with open(path, "rb") as fh:
+            assert fh.read() == frame, (
+                f"corpus file {name} drifted from its builder; run "
+                "`python tests/fuzz_wire.py --regen`"
+            )
+
+
+def test_corpus_round_trips():
+    """Every checked-in frame decodes cleanly and round-trips on every
+    surface (the 'base' case the mutator starts from)."""
+    for surface, parsers in sorted(SURFACES.items()):
+        for parser in parsers:
+            drive = _DRIVERS[parser]
+            for i, frame in enumerate(load_corpus(parser)):
+                outcome = drive(frame)
+                assert outcome.startswith("ok:"), (
+                    f"{surface}/{parser} corpus frame {i} rejected: "
+                    f"{outcome}"
+                )
+
+
+def test_fuzz_all_surfaces_seed0():
+    digest, cases = fuzz_run(seed=0, iterations=40)
+    assert cases > 0 and len(digest) == 64
+
+
+def test_fuzz_all_surfaces_seed1():
+    digest, cases = fuzz_run(seed=1, iterations=40)
+    assert cases > 0 and len(digest) == 64
+
+
+def test_same_seed_replay_is_byte_identical():
+    first, n1 = fuzz_run(seed=7, iterations=25)
+    second, n2 = fuzz_run(seed=7, iterations=25)
+    assert (first, n1) == (second, n2)
+
+
+def test_different_seeds_mutate_differently():
+    a, _ = fuzz_run(seed=2, iterations=25, surfaces=["protocol"])
+    b, _ = fuzz_run(seed=3, iterations=25, surfaces=["protocol"])
+    assert a != b
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,7 @@ from scripts.analysis.hygiene import HygieneChecker
 from scripts.analysis.jaxpurity import JaxPurityChecker
 from scripts.analysis.locks import LockDisciplineChecker
 from scripts.analysis.metrics_checks import MetricsChecker
+from scripts.analysis.taint import TaintChecker
 from scripts.analysis.wire import WireCompatChecker
 
 
@@ -1289,10 +1290,11 @@ class TestFramework:
 
     def test_baseline_roundtrip(self, tmp_path):
         path = str(tmp_path / "baseline.txt")
+        (tmp_path / "a.py").write_text("")  # keys for live files survive
         f1 = Finding("a.py", 1, "TPH002", "x")
         f2 = Finding("a.py", 9, "TPH002", "x")  # same key, twice
         write_baseline(path, [f1, f2])
-        baseline = load_baseline(path)
+        baseline = load_baseline(path, repo_root=str(tmp_path))
         new, stale = diff_baseline([f1, f2], baseline)
         assert new == [] and stale == []
         # a third identical finding is NEW (multiset semantics)
@@ -1305,14 +1307,35 @@ class TestFramework:
 
     def test_line_drift_does_not_unbaseline(self, tmp_path):
         path = str(tmp_path / "baseline.txt")
+        (tmp_path / "a.py").write_text("")
         write_baseline(path, [Finding("a.py", 10, "TPH002", "x")])
         moved = Finding("a.py", 999, "TPH002", "x")
-        new, stale = diff_baseline([moved], load_baseline(path))
+        new, stale = diff_baseline(
+            [moved], load_baseline(path, repo_root=str(tmp_path))
+        )
         assert new == [] and stale == []
+
+    def test_baseline_prunes_deleted_files(self, tmp_path):
+        # an entry whose file is gone is dropped at load (reported via
+        # the pruned list), not kept as permanent dead weight
+        path = str(tmp_path / "baseline.txt")
+        (tmp_path / "live.py").write_text("")
+        write_baseline(path, [
+            Finding("live.py", 1, "TPH002", "x"),
+            Finding("deleted.py", 1, "TPH002", "y"),
+        ])
+        pruned = []
+        baseline = load_baseline(
+            path, repo_root=str(tmp_path), pruned=pruned
+        )
+        assert pruned == ["deleted.py: TPH002 y"]
+        assert list(baseline) == ["live.py: TPH002 x"]
 
     def test_registry_covers_all_families(self):
         reg = checker_registry()
-        assert set(reg) == {"locks", "jaxpurity", "wire", "hygiene", "metrics"}
+        assert set(reg) == {
+            "locks", "jaxpurity", "wire", "hygiene", "metrics", "taint",
+        }
 
     def test_comment_in_string_is_not_an_annotation(self):
         src = '''
@@ -1321,6 +1344,192 @@ class TestFramework:
                     self.x = "text with # guarded-by: _mtx inside"
         '''
         assert run_on(LockDisciplineChecker(), {"m.py": src}) == []
+
+
+# --- taint (tpuflow) ---------------------------------------------------------
+
+
+# fixtures live at a surface path so their read_* calls count as sources
+_SURF = "tendermint_tpu/verifyd/protocol.py"
+# a non-surface module: sinks here still fire when taint FLOWS in, but
+# its own read_*/unpack calls are trusted local data
+_SINK = "tendermint_tpu/verifyd/server.py"
+
+
+TAINT_ALLOC_DIRTY = """
+    def decode(r):
+        n = r.read_varint()
+        return bytearray(n)
+"""
+
+TAINT_ALLOC_CLEAN = """
+    def decode(r):
+        n = r.read_varint()
+        if n > 4096:
+            raise ValueError("length too large")
+        return bytearray(n)
+"""
+
+TAINT_BLOCK_DIRTY = """
+    def handle(r, done):
+        t = r.read_varint()
+        done.wait(timeout=t)
+"""
+
+TAINT_BLOCK_CLEAN = """
+    def handle(r, done):
+        t = r.read_varint()
+        t = min(t, 60)
+        done.wait(timeout=t)
+"""
+
+TAINT_LOOP_DIRTY = """
+    def drain(r):
+        n = r.read_varint()
+        out = []
+        for _ in range(n):
+            out.append(r.read_bytes())
+        return out
+"""
+
+TAINT_LOOP_CLEAN = """
+    def drain(r):
+        n = r.read_varint()
+        if n > 64:
+            raise ValueError("too many entries")
+        out = []
+        for _ in range(n):
+            out.append(r.read_bytes())
+        return out
+"""
+
+TAINT_KEY_DIRTY = """
+    def ingest(r):
+        table = {}
+        key = r.read_bytes()
+        table[key] = 1
+        return table
+"""
+
+TAINT_KEY_CLEAN = """
+    def ingest(r):
+        table = {}
+        key = r.read_bytes()
+        if len(table) < 100:
+            table[key] = 1
+        return table
+"""
+
+# the cross-module flow the checker exists for: a decode helper in a
+# surface module returns wire data, a server module spends it on a
+# blocking wait
+TAINT_INTER_SURFACE = """
+    def read_deadline(r):
+        return r.read_varint()
+"""
+
+TAINT_INTER_SINK_DIRTY = """
+    from tendermint_tpu.verifyd.protocol import read_deadline
+
+    def serve(r, done):
+        t = read_deadline(r)
+        done.wait(timeout=t)
+"""
+
+TAINT_INTER_SINK_CLEAN = """
+    from tendermint_tpu.verifyd.protocol import read_deadline
+
+    def serve(r, done):
+        t = read_deadline(r)
+        if t > 600:
+            raise ValueError("deadline too far out")
+        done.wait(timeout=t)
+"""
+
+TAINT_ANNOT_USED = """
+    def decode(r):
+        n = r.read_varint()
+        # tpuflow: sanitized=caller enforces the frame cap upstream
+        return bytearray(n)
+"""
+
+TAINT_ANNOT_STALE = """
+    def decode(r):
+        n = 4
+        # tpuflow: sanitized=nothing tainted reaches this line anymore
+        return bytearray(n)
+"""
+
+TAINT_ANNOT_MALFORMED = """
+    def decode(r):
+        n = r.read_varint()
+        if n > 4096:
+            raise ValueError("length too large")
+        # tpuflow: sanitized=
+        return bytearray(n)
+"""
+
+
+class TestTaint:
+    def test_tainted_alloc_size_flags(self):
+        findings = run_on(TaintChecker(), {_SURF: TAINT_ALLOC_DIRTY})
+        assert codes(findings) == ["TPT001"]
+
+    def test_range_guard_clears_alloc_size(self):
+        assert run_on(TaintChecker(), {_SURF: TAINT_ALLOC_CLEAN}) == []
+
+    def test_tainted_blocking_bound_flags(self):
+        findings = run_on(TaintChecker(), {_SURF: TAINT_BLOCK_DIRTY})
+        assert codes(findings) == ["TPT002"]
+
+    def test_min_clamp_clears_blocking_bound(self):
+        assert run_on(TaintChecker(), {_SURF: TAINT_BLOCK_CLEAN}) == []
+
+    def test_tainted_loop_bound_flags(self):
+        findings = run_on(TaintChecker(), {_SURF: TAINT_LOOP_DIRTY})
+        assert "TPT002" in codes(findings)
+
+    def test_range_guard_clears_loop_bound(self):
+        assert run_on(TaintChecker(), {_SURF: TAINT_LOOP_CLEAN}) == []
+
+    def test_tainted_key_grows_mapping_flags(self):
+        findings = run_on(TaintChecker(), {_SURF: TAINT_KEY_DIRTY})
+        assert codes(findings) == ["TPT003"]
+
+    def test_cardinality_cap_clears_mapping_growth(self):
+        assert run_on(TaintChecker(), {_SURF: TAINT_KEY_CLEAN}) == []
+
+    def test_interprocedural_taint_crosses_modules(self):
+        findings = run_on(TaintChecker(), {
+            _SURF: TAINT_INTER_SURFACE,
+            _SINK: TAINT_INTER_SINK_DIRTY,
+        })
+        assert codes(findings) == ["TPT002"]
+        assert findings[0].path == _SINK
+
+    def test_interprocedural_guard_at_callsite_clears(self):
+        assert run_on(TaintChecker(), {
+            _SURF: TAINT_INTER_SURFACE,
+            _SINK: TAINT_INTER_SINK_CLEAN,
+        }) == []
+
+    def test_sources_only_fire_in_surface_modules(self):
+        # the same dirty code in a NON-surface module reads trusted
+        # local bytes: no taint, no findings
+        assert run_on(TaintChecker(), {_SINK: TAINT_ALLOC_DIRTY}) == []
+
+    def test_annotation_suppresses_and_counts_as_used(self):
+        assert run_on(TaintChecker(), {_SURF: TAINT_ANNOT_USED}) == []
+
+    def test_stale_annotation_flags_tpt004(self):
+        findings = run_on(TaintChecker(), {_SURF: TAINT_ANNOT_STALE})
+        assert codes(findings) == ["TPT004"]
+        assert "stale" in findings[0].message
+
+    def test_malformed_annotation_flags_tpt004(self):
+        findings = run_on(TaintChecker(), {_SURF: TAINT_ANNOT_MALFORMED})
+        assert codes(findings) == ["TPT004"]
+        assert "malformed" in findings[0].message
 
 
 # --- the repo itself ---------------------------------------------------------

@@ -397,6 +397,55 @@ class TestFleetStats:
             for s in servers:
                 s.stop()
 
+    def test_refresh_drops_and_counts_inflated_gossip_snapshot(self):
+        """A misbehaving shard's oversized STATS snapshot must not
+        balloon the fleet view: before the gossip caps, refresh() stored
+        whatever JSON the shard returned. Now the snapshot is dropped
+        whole and counted in gossip_rejects, while the shard itself
+        stays alive (it answered; only its gossip is rejected)."""
+        from tendermint_tpu.verifyd import federation as fedmod
+
+        servers, addrs = start_shards(2)
+        fed = FederationClient(addrs, dead_retry_s=60.0)
+        try:
+            inflated = {
+                "tenants": {
+                    f"t{i}": {"p99_ms": 1.0}
+                    for i in range(fedmod.MAX_GOSSIP_TENANTS + 1)
+                }
+            }
+            fed._clients[1].server_stats = (
+                lambda timeout=2.0, _s=inflated: _s
+            )
+            snaps = fed.refresh(timeout=2.0)
+            assert 0 in snaps and 1 not in snaps
+            assert fed.gossip_rejects == 1
+            assert fed.alive_shards() == [0, 1]
+            # the rejected snapshot's tenants never reach the fleet view
+            assert "t0" not in fed.fleet_tenants()
+            assert fed.stats()["gossip_rejects"] == 1
+        finally:
+            fed.close()
+            for s in servers:
+                s.stop()
+
+    def test_sanitize_snapshot_caps(self):
+        from tendermint_tpu.verifyd import federation as fedmod
+
+        sanitize = FederationClient._sanitize_snapshot
+        ok = {"tenants": {"a": {"p99_ms": 1.0}}, "brownout": {}}
+        assert sanitize(ok) is ok
+        with pytest.raises(ValueError, match="tenants"):
+            sanitize({
+                "tenants": {
+                    f"t{i}": {} for i in range(fedmod.MAX_GOSSIP_TENANTS + 1)
+                }
+            })
+        with pytest.raises(ValueError, match="B$"):
+            sanitize({"pad": "x" * fedmod.MAX_GOSSIP_SNAPSHOT_BYTES})
+        with pytest.raises(ValueError, match="not a dict"):
+            sanitize(["not", "a", "dict"])
+
     def test_slo_propagates_to_every_shard(self):
         """Satellite 1: one ``--tenant-slo`` reaches ALL shards
         identically (wire field 8), so the merged fleet view carries

@@ -542,6 +542,52 @@ def test_strip_padding_rejects_malformed():
     assert _strip_padding(FLAG_PADDED, b"\x03\x00\x00\x00") == b""
 
 
+def test_read_frame_rejects_oversized_declared_length():
+    """A peer-declared frame length past our advertised
+    SETTINGS_MAX_FRAME_SIZE is a typed H2ProtocolError raised from the
+    9-byte header alone — before the fix, read_frame would trust the
+    declared length and block allocating up to 16MB-1 of peer-chosen
+    payload buffer."""
+    import socket as socketlib
+
+    from tendermint_tpu.libs.grpc import (
+        FRAME_DATA,
+        H2ProtocolError,
+        MAX_FRAME,
+        read_frame,
+    )
+
+    a, b = socketlib.socketpair()
+    a.settimeout(5)
+    b.settimeout(5)
+    try:
+        hdr = (
+            (MAX_FRAME + 1).to_bytes(3, "big")
+            + bytes([FRAME_DATA, 0])
+            + (1).to_bytes(4, "big")
+        )
+        b.sendall(hdr)  # header only: the guard must not wait for payload
+        with pytest.raises(H2ProtocolError, match="exceeds"):
+            read_frame(a)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_server_rejects_oversized_declared_frame():
+    from tendermint_tpu.libs.grpc import FRAME_DATA, MAX_FRAME
+
+    # declared length MAX_FRAME+1 with no payload behind it: the server
+    # must fail the connection as a protocol error instead of buffering
+    # forever waiting for 16MB that never comes
+    hdr = (
+        (MAX_FRAME + 1).to_bytes(3, "big")
+        + bytes([FRAME_DATA, 0])
+        + (1).to_bytes(4, "big")
+    )
+    _drive_server_conn(hdr)
+
+
 # --- server loop: split header blocks and padded frames ----------------------
 # ROADMAP known debt (ISSUE 6 satellite): pin that PR 5's hardening of
 # the SERVER loop holds for the same frame shapes the client loop was

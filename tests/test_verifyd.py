@@ -96,6 +96,36 @@ def test_protocol_rejects_malformed():
         protocol.decode_request(protocol.encode_request(req))
 
 
+def test_protocol_rejects_oversized_varint_fields():
+    """Every bounded varint field must reject values past its documented
+    maximum with a typed error at DECODE time — an uncapped 64-bit
+    varint otherwise flows straight into server arithmetic (deadline_ms
+    used to reach ``entry.done.wait(timeout=...)`` unchecked before the
+    MAX_DEADLINE_MS cap existed; tpuflow TPT002 caught it)."""
+    pks, msgs, sigs = make_lanes(1)
+
+    def wire(**overrides):
+        req = protocol.VerifyRequest(pks=pks, msgs=msgs, sigs=sigs)
+        for k, v in overrides.items():
+            setattr(req, k, v)
+        return protocol.encode_request(req)
+
+    # at the cap: accepted
+    ok = protocol.decode_request(wire(deadline_ms=protocol.MAX_DEADLINE_MS))
+    assert ok.deadline_ms == protocol.MAX_DEADLINE_MS
+    # one past the cap: typed rejection, never a silent accept
+    with pytest.raises(ValueError, match="deadline_ms too large"):
+        protocol.decode_request(wire(deadline_ms=protocol.MAX_DEADLINE_MS + 1))
+    with pytest.raises(ValueError, match="slo_ms too large"):
+        protocol.decode_request(wire(slo_ms=protocol.MAX_SLO_MS + 1))
+    with pytest.raises(ValueError, match="route epoch too large"):
+        protocol.decode_request(
+            wire(route_epoch=protocol.MAX_ROUTE_EPOCH + 1)
+        )
+    with pytest.raises(ValueError, match="shard id too large"):
+        protocol.decode_request(wire(shard_id=protocol.MAX_SHARD_ID + 1))
+
+
 def test_protocol_tenant_roundtrip_and_old_frame_compat():
     """Field 6 (tenant) follows proto3 zero-omission: the default
     tenant is never encoded, so frames from pre-tenant clients and

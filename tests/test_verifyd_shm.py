@@ -178,6 +178,7 @@ class TestSlabHeader:
             (shm.SLAB_OFF_LANES, shm.SHM_MAX_LANES + 1),
             (shm.SLAB_OFF_TENANT_LEN, protocol.MAX_TENANT_LEN + 1),
             (shm.SLAB_OFF_SLO_MS, protocol.MAX_SLO_MS + 1),
+            (shm.SLAB_OFF_DEADLINE_MS, protocol.MAX_DEADLINE_MS + 1),
         ):
             buf = self._buf()
             shm.pack_header(
